@@ -1,0 +1,31 @@
+"""pytorch_distributed_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+HFAiLab/pytorch_distributed (ResNet-50/ImageNet data-parallel training,
+single chip → multi-host pod):
+
+- ``models``   — ResNet family in flax (ref: torchvision.models.resnet50,
+  ``resnet_single_gpu.py:83``).
+- ``ops``      — loss / metrics / optimizer / LR schedule / precision policy
+  (ref: ``torch.optim.SGD`` + ``StepLR`` + ``nn.CrossEntropyLoss``,
+  ``resnet_single_gpu.py:107-109``; AMP ``resnet_ddp_apex.py:27-33``).
+- ``parallel`` — device mesh, ``jax.distributed`` rendezvous, SPMD data
+  parallelism over ICI/DCN (ref: NCCL process group + DDP,
+  ``restnet_ddp.py:94-99``).
+- ``data``     — packed-record dataset (ffrecord-style, C++ reader core),
+  DistributedSampler semantics, host→device pipeline (ref: ``hfai.datasets``,
+  ``restnet_ddp.py:107-119``).
+- ``train``    — one SPMD trainer serving all four reference recipes, with
+  suspend/checkpoint/resume (ref: ``restnet_ddp.py:36-47,127-132``).
+- ``utils``    — env manifest pinning (ref: ``hf_env.set_env``), logging,
+  profiling.
+
+The reference's four scripts differ only in how replicas communicate; here
+that difference collapses into sharding specs on one trainer (SURVEY.md §7).
+"""
+
+__version__ = "0.1.0"
+
+from pytorch_distributed_tpu.utils.env import set_env
+
+__all__ = ["set_env", "__version__"]

@@ -1,0 +1,22 @@
+from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.ops.metrics import topk_correct, ClassificationMetrics
+from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay, build_optimizer
+from pytorch_distributed_tpu.ops.precision import (
+    Policy,
+    DynamicLossScaler,
+    NoOpLossScaler,
+)
+from pytorch_distributed_tpu.ops.schedules import step_lr, warmup_cosine
+
+__all__ = [
+    "cross_entropy_loss",
+    "topk_correct",
+    "ClassificationMetrics",
+    "sgd_with_weight_decay",
+    "build_optimizer",
+    "Policy",
+    "DynamicLossScaler",
+    "NoOpLossScaler",
+    "step_lr",
+    "warmup_cosine",
+]

@@ -1,0 +1,52 @@
+"""Learning-rate schedules as pure functions of the global step.
+
+Replaces ``torch.optim.lr_scheduler.StepLR(optimizer, step_size=30,
+gamma=0.1)`` (``resnet_single_gpu.py:109``, ``restnet_ddp.py:123``). The
+torch scheduler is stateful (``scheduler.step()`` per epoch,
+``state_dict`` checkpointed); here the schedule is a pure function of the
+step counter, so checkpointing the step *is* checkpointing the scheduler —
+one less thing to restore (ref resume path ``restnet_ddp.py:127-132``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def step_lr(
+    base_lr: float,
+    steps_per_epoch: int,
+    step_size_epochs: int = 30,
+    gamma: float = 0.1,
+):
+    """lr = base * gamma ** (epoch // step_size_epochs), epoch derived from step."""
+
+    def schedule(step):
+        epoch = jnp.asarray(step, jnp.float32) // float(max(steps_per_epoch, 1))
+        exponent = jnp.floor(epoch / float(step_size_epochs))
+        return base_lr * jnp.power(gamma, exponent)
+
+    return schedule
+
+
+def warmup_cosine(
+    base_lr: float,
+    total_steps: int,
+    warmup_steps: int = 0,
+    final_lr: float = 0.0,
+):
+    """Linear warmup then cosine decay — the modern large-batch recipe the
+    reference lacks; provided because TPU pods favor bigger global batches
+    than bs-400-per-replica SGD+StepLR was tuned for."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = base_lr * step / jnp.maximum(float(warmup_steps), 1.0)
+        progress = (step - warmup_steps) / jnp.maximum(
+            float(total_steps - warmup_steps), 1.0
+        )
+        progress = jnp.clip(progress, 0.0, 1.0)
+        cos = final_lr + 0.5 * (base_lr - final_lr) * (1 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_steps, warm, cos)
+
+    return schedule

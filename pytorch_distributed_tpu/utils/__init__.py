@@ -1,0 +1,4 @@
+from pytorch_distributed_tpu.utils.env import set_env
+from pytorch_distributed_tpu.utils.logging import rank0_print, get_logger
+
+__all__ = ["set_env", "rank0_print", "get_logger"]

@@ -1,0 +1,104 @@
+"""Versioned runtime-environment manifest.
+
+TPU-native replacement for the reference's cluster environment pinning
+(``import hf_env; hf_env.set_env('202111')`` — the first two lines of every
+reference script). Instead of swapping a container image, we verify the
+installed JAX/flax/optax stack against a named manifest and configure
+TPU-friendly process-level defaults (compilation cache, preallocation).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+logger = logging.getLogger("pytorch_distributed_tpu")
+
+
+@dataclass(frozen=True)
+class EnvManifest:
+    """Minimum-version pins for a named environment."""
+
+    name: str
+    min_versions: dict = field(default_factory=dict)
+    env_defaults: dict = field(default_factory=dict)
+
+
+# Manifests are named by YYYYMM like the reference's '202111'.
+MANIFESTS = {
+    "202607": EnvManifest(
+        name="202607",
+        min_versions={"jax": (0, 5), "flax": (0, 10), "optax": (0, 2)},
+        env_defaults={
+            # Persistent XLA compilation cache: first compile of a big step
+            # function is ~20-40s on TPU; cache makes relaunches (and the
+            # suspend/resume cycle) cheap.
+            "JAX_COMPILATION_CACHE_DIR": os.path.expanduser(
+                "~/.cache/pytorch_distributed_tpu/xla"
+            ),
+        },
+    ),
+}
+
+_active_env: str | None = None
+
+
+def _version_tuple(version: str) -> tuple:
+    parts = []
+    for piece in version.split(".")[:3]:
+        digits = "".join(ch for ch in piece if ch.isdigit())
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts)
+
+
+def set_env(name: str = "202607", strict: bool = False) -> EnvManifest:
+    """Pin and verify the runtime environment.
+
+    Mirrors ``hf_env.set_env(version)`` (every reference script, lines 1-2):
+    call once at program start, before heavy imports do real work.
+
+    Args:
+      name: manifest name (default the current one).
+      strict: raise on a version pin violation instead of warning.
+    """
+    global _active_env
+    manifest = MANIFESTS.get(name)
+    if manifest is None:
+        raise ValueError(
+            f"unknown environment manifest {name!r}; known: {sorted(MANIFESTS)}"
+        )
+
+    for key, value in manifest.env_defaults.items():
+        os.environ.setdefault(key, value)
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+
+    import importlib
+
+    for mod_name, min_version in manifest.min_versions.items():
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError:
+            msg = f"environment {name!r} requires {mod_name} but it is not installed"
+            if strict:
+                raise RuntimeError(msg)
+            logger.warning(msg)
+            continue
+        have = _version_tuple(getattr(mod, "__version__", "0"))
+        if have < tuple(min_version):
+            msg = (
+                f"environment {name!r} pins {mod_name}>="
+                f"{'.'.join(map(str, min_version))}, found {mod.__version__}"
+            )
+            if strict:
+                raise RuntimeError(msg)
+            logger.warning(msg)
+
+    _active_env = name
+    return manifest
+
+
+def active_env() -> str | None:
+    return _active_env

@@ -1,0 +1,43 @@
+"""Rank-aware logging.
+
+The reference gates all user-visible output on rank 0 and flushes every print
+(``restnet_ddp.py:66-70,145-146``, ``resnet_single_gpu.py:23-24``). Here that
+policy lives in one place instead of being re-implemented per script.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def get_logger(name: str = "pytorch_distributed_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+    return logger
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax.distributed not initialised / no backend yet
+        return 0
+
+
+def is_rank0() -> bool:
+    return _process_index() == 0
+
+
+def rank0_print(*args, **kwargs) -> None:
+    """``print(..., flush=True)`` on process 0 only (ref ``restnet_ddp.py:70``)."""
+    if is_rank0():
+        kwargs.setdefault("flush", True)
+        print(*args, **kwargs)

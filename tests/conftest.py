@@ -1,0 +1,32 @@
+"""Test harness: 8 virtual CPU devices.
+
+Multi-device behavior (pjit sharding, psum reductions, sampler shard logic)
+is exercised without TPUs via XLA's host-platform device-count override —
+the strategy SURVEY.md §4 prescribes. Must run before jax initializes a
+backend, hence module-level in conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# A site-installed TPU plugin may have forced its own platform list into the
+# jax config at interpreter start (overriding JAX_PLATFORMS); force CPU back
+# before any backend is initialized so tests never touch real accelerators.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices[:8]

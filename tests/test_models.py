@@ -1,0 +1,69 @@
+"""Model parity tests vs torchvision (structure-level, CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu import models
+
+
+def _param_count(params):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+def _init(model, image_size=32, batch=2):
+    x = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    return variables, x
+
+
+def test_resnet50_param_count_matches_torchvision():
+    # torchvision.models.resnet50() has 25,557,032 parameters
+    # (ref model: resnet_single_gpu.py:83).
+    model = models.resnet50()
+    variables, _ = _init(model, image_size=32)
+    assert _param_count(variables["params"]) == 25_557_032
+
+
+def test_resnet18_param_count_matches_torchvision():
+    model = models.resnet18()
+    variables, _ = _init(model, image_size=32)
+    assert _param_count(variables["params"]) == 11_689_512
+
+
+def test_forward_shapes_and_finite():
+    model = models.resnet50(num_classes=10)
+    variables, x = _init(model, image_size=32, batch=2)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_train_mode_updates_batch_stats():
+    model = models.resnet18(num_classes=4, num_filters=8)
+    variables, x = _init(model, image_size=16, batch=4)
+    x = jax.random.normal(jax.random.key(1), x.shape)
+    logits, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    old = jax.tree.leaves(variables["batch_stats"])
+    new = jax.tree.leaves(mutated["batch_stats"])
+    changed = any(not np.allclose(a, b) for a, b in zip(old, new))
+    assert changed, "train=True must update running BN statistics"
+
+
+def test_bf16_compute_keeps_fp32_params_and_logits():
+    model = models.resnet18(num_classes=4, num_filters=8, dtype=jnp.bfloat16)
+    variables, x = _init(model, image_size=16, batch=2)
+    for leaf in jax.tree.leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32
+    logits = model.apply(variables, x, train=False)
+    assert logits.dtype == jnp.float32
+
+
+@pytest.mark.parametrize(
+    "builder,expected_blocks",
+    [(models.resnet34, (3, 4, 6, 3)), (models.resnet101, (3, 4, 23, 3))],
+)
+def test_family_stage_sizes(builder, expected_blocks):
+    assert tuple(builder().stage_sizes) == expected_blocks
