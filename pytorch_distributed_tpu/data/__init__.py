@@ -1,0 +1,17 @@
+from pytorch_distributed_tpu.data.sampler import DistributedSampler
+from pytorch_distributed_tpu.data.loader import DataLoader
+from pytorch_distributed_tpu.data.synthetic import SyntheticImageClassification
+from pytorch_distributed_tpu.data.imagenet import ImageNet
+from pytorch_distributed_tpu.data.packed_record import (
+    PackedRecordWriter,
+    PackedRecordReader,
+)
+
+__all__ = [
+    "DistributedSampler",
+    "DataLoader",
+    "SyntheticImageClassification",
+    "ImageNet",
+    "PackedRecordWriter",
+    "PackedRecordReader",
+]

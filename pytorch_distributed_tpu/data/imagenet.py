@@ -1,0 +1,122 @@
+"""ImageNet over TPRC packed records.
+
+Replaces ``hfai.datasets.ImageNet(split, transform)`` + ``.loader(...)``
+(reference D2; ``restnet_ddp.py:107-109,117-119``). Storage layout: one
+TPRC file per split (``train.tprc`` / ``val.tprc``) whose records are
+``u32 label || JPEG bytes`` — the packed-file design that let the reference
+sustain >5 000 img/s from a cluster filesystem, rebuilt on our own
+container format (data/packed_record.py, C++ read core).
+
+``ImageNet.loader(...)`` mirrors the reference's call shape so recipes read
+the same. A conversion helper builds TPRC splits from any (bytes, label)
+iterator — e.g. a torchvision ImageFolder walk on the host that owns the
+raw dataset.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+from typing import Callable, Iterable, Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.data import transforms as T
+from pytorch_distributed_tpu.data.loader import DataLoader
+from pytorch_distributed_tpu.data.packed_record import (
+    PackedRecordReader,
+    PackedRecordWriter,
+)
+
+_LABEL = struct.Struct("<I")
+
+DEFAULT_DATA_DIR = os.environ.get(
+    "PDT_IMAGENET_DIR", os.path.expanduser("~/datasets/imagenet-tprc")
+)
+
+
+def write_imagenet_split(
+    path: str,
+    samples: Iterable[Tuple[bytes, int]],
+    with_crc: bool = True,
+) -> int:
+    """Pack (jpeg_bytes, label) pairs into one TPRC split file."""
+    count = 0
+    with PackedRecordWriter(path, with_crc=with_crc) as w:
+        for jpeg, label in samples:
+            w.write(_LABEL.pack(label) + jpeg)
+            count += 1
+    return count
+
+
+class ImageNet:
+    """Packed-record ImageNet split with torch-Dataset-style indexing.
+
+    ``dataset[i]`` decodes record i → (transformed image, label). Decode is
+    host-side PIL (the loader parallelizes it across worker threads);
+    transform is the reference's train/val pipeline by default.
+    """
+
+    def __init__(
+        self,
+        split: str = "train",
+        transform: Optional[Callable] = None,
+        data_dir: str = DEFAULT_DATA_DIR,
+        use_native: bool | None = None,
+    ):
+        self.split = split
+        self.path = os.path.join(data_dir, f"{split}.tprc")
+        if not os.path.exists(self.path):
+            raise FileNotFoundError(
+                f"packed split not found: {self.path} — build it with "
+                "pytorch_distributed_tpu.data.imagenet.write_imagenet_split()"
+            )
+        self.reader = PackedRecordReader(self.path, use_native=use_native)
+        if transform is None:
+            transform = (
+                T.train_transform() if split == "train" else T.eval_transform()
+            )
+        self.transform = transform
+
+    def __len__(self) -> int:
+        return len(self.reader)
+
+    def _decode(self, record: bytes, rng: np.random.Generator):
+        (label,) = _LABEL.unpack(record[: _LABEL.size])
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(record[_LABEL.size :]))
+        img = img.convert("RGB")
+        if self.transform is not None:
+            img = self.transform(img, rng)
+        return np.asarray(img, np.float32), int(label)
+
+    def getitem_rng(self, i: int, rng: np.random.Generator):
+        """Deterministic-augmentation entry point: the loader derives ``rng``
+        from (seed, epoch, index), so resumed runs see identical crops/flips."""
+        return self._decode(self.reader.read(int(i)), rng)
+
+    def __getitem__(self, i: int):
+        return self.getitem_rng(i, np.random.default_rng())
+
+    def loader(
+        self,
+        batch_size: int,
+        sampler=None,
+        num_workers: int = 4,
+        drop_last: bool = True,
+        prefetch: int = 2,
+        **_compat,
+    ) -> DataLoader:
+        """Reference-shaped loader factory (``train_dataset.loader(...)``,
+        ``restnet_ddp.py:109``). ``pin_memory`` etc. are accepted and ignored
+        (device transfer is handled by the trainer's prefetcher)."""
+        return DataLoader(
+            self,
+            batch_size=batch_size,
+            sampler=sampler,
+            num_workers=num_workers,
+            drop_last=drop_last,
+            prefetch=prefetch,
+        )

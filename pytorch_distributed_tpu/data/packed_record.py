@@ -1,0 +1,207 @@
+"""TPRC packed-record container: high-throughput sequential storage for
+variable-length records (JPEG bytes, serialized samples).
+
+TPU-native replacement for ffrecord (reference dependency D2 —
+``hfai.datasets.ImageNet`` over ``/public_dataset/1/ImageNet/{train,val}.ffr``,
+``README.md:14-18``): millions of small files collapse into a few large
+sequential files so the cluster filesystem sees large reads, with O(1)
+random access via an in-memory offset table — exactly the property the
+reference leaned on for its 5 500 img/s input pipeline.
+
+Layout (little-endian):
+
+    magic "TPRC" | version u32 | n u64 | flags u64
+    offsets u64[n+1]      payload-relative record boundaries
+    crcs u32[n]           iff flags & 1
+    payload               concatenated record bytes
+
+Two readers share the format:
+- ``PackedRecordReader`` — pure numpy/mmap-free Python (portable fallback);
+- the C++ core in ``csrc/recordio.cpp`` (pread-based, thread-safe batch
+  gather), loaded via ctypes when a toolchain is available. The Python and
+  native readers are interchangeable and parity-tested.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import struct
+import zlib
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from pytorch_distributed_tpu.data import native
+
+_MAGIC = b"TPRC"
+_VERSION = 1
+_FLAG_CRC = 1
+_HEADER = struct.Struct("<4sIQQ")
+
+
+class PackedRecordWriter:
+    """Streaming writer; records are raw ``bytes``.
+
+    Payload streams to a temp file as records arrive (memory stays O(record
+    count), not O(payload) — the ImageNet train split is ~150 GB); the final
+    file (header + tables + payload) is assembled and atomically published at
+    ``close()``. An exception inside the ``with`` block abandons the write:
+    nothing is published and temp files are removed, so a crashed pack can
+    never be mistaken for a complete split.
+    """
+
+    def __init__(self, path: str | os.PathLike, with_crc: bool = True):
+        self.path = os.fspath(path)
+        self.with_crc = with_crc
+        self._payload_tmp = self.path + ".payload.tmp"
+        self._payload = open(self._payload_tmp, "wb")
+        self._offsets = [0]
+        self._crcs: list[int] = []
+        self._closed = False
+
+    def write(self, record: bytes) -> int:
+        """Append one record; returns its index."""
+        if self._closed:
+            raise ValueError("writer is closed")
+        self._payload.write(record)
+        self._offsets.append(self._offsets[-1] + len(record))
+        if self.with_crc:
+            self._crcs.append(zlib.crc32(record) & 0xFFFFFFFF)
+        return len(self._offsets) - 2
+
+    def write_all(self, records: Iterable[bytes]) -> None:
+        for r in records:
+            self.write(r)
+
+    def abort(self) -> None:
+        """Discard everything written; publish nothing."""
+        if self._closed:
+            return
+        self._closed = True
+        self._payload.close()
+        for p in (self._payload_tmp, self.path + ".tmp"):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._payload.close()
+        n = len(self._offsets) - 1
+        flags = _FLAG_CRC if self.with_crc else 0
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "wb") as f, open(self._payload_tmp, "rb") as payload:
+                f.write(_HEADER.pack(_MAGIC, _VERSION, n, flags))
+                f.write(np.asarray(self._offsets, "<u8").tobytes())
+                if self.with_crc:
+                    f.write(np.asarray(self._crcs, "<u4").tobytes())
+                shutil.copyfileobj(payload, f, length=16 * 1024 * 1024)
+            os.replace(tmp, self.path)  # atomic publish
+        finally:
+            try:
+                os.remove(self._payload_tmp)
+            except FileNotFoundError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        else:
+            self.close()
+
+
+class _PyReader:
+    """Pure-Python pread reader (fallback when no native library)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "rb", buffering=0)
+        header = self._f.read(_HEADER.size)
+        if len(header) < _HEADER.size:
+            raise ValueError(f"{path}: truncated TPRC header")
+        magic, version, n, flags = _HEADER.unpack(header)
+        if magic != _MAGIC or version != _VERSION:
+            raise ValueError(f"{path}: not a TPRC v{_VERSION} file")
+        self.n = n
+        self.flags = flags
+        raw = self._f.read(8 * (n + 1))
+        if len(raw) < 8 * (n + 1):
+            raise ValueError(f"{path}: truncated TPRC offset table")
+        self.offsets = np.frombuffer(raw, "<u8")
+        self.crcs = None
+        payload_start = _HEADER.size + 8 * (n + 1)
+        if flags & _FLAG_CRC:
+            raw = self._f.read(4 * n)
+            if len(raw) < 4 * n:
+                raise ValueError(f"{path}: truncated TPRC crc table")
+            self.crcs = np.frombuffer(raw, "<u4")
+            payload_start += 4 * n
+        self.payload_start = payload_start
+
+    def read(self, i: int, verify_crc: bool = True) -> bytes:
+        start, end = int(self.offsets[i]), int(self.offsets[i + 1])
+        data = os.pread(self._f.fileno(), end - start, self.payload_start + start)
+        if verify_crc and self.crcs is not None:
+            if zlib.crc32(data) & 0xFFFFFFFF != int(self.crcs[i]):
+                raise IOError(f"crc mismatch in record {i}")
+        return data
+
+    def close(self):
+        self._f.close()
+
+
+class PackedRecordReader:
+    """O(1) random access over a TPRC file.
+
+    Uses the C++ pread core when available (``use_native=None`` auto-detects),
+    the Python fallback otherwise. Thread-safe for concurrent reads either
+    way (stateless pread in both).
+    """
+
+    def __init__(self, path: str | os.PathLike, use_native: bool | None = None):
+        self.path = os.fspath(path)
+        self._native = None
+        self._py = None
+        if use_native is None:
+            use_native = native.available()
+        if use_native:
+            self._native = native.NativeReader(self.path)
+            self.n = self._native.n
+        else:
+            self._py = _PyReader(self.path)
+            self.n = self._py.n
+
+    def __len__(self) -> int:
+        return self.n
+
+    def read(self, i: int, verify_crc: bool = True) -> bytes:
+        if not 0 <= i < self.n:
+            raise IndexError(i)
+        if self._native is not None:
+            return self._native.read(i, verify_crc)
+        return self._py.read(i, verify_crc)
+
+    def read_batch(self, indices: Sequence[int], verify_crc: bool = True) -> list[bytes]:
+        """Gather many records (single native call when available)."""
+        if self._native is not None:
+            return self._native.read_batch(indices, verify_crc)
+        return [self.read(int(i), verify_crc) for i in indices]
+
+    def close(self) -> None:
+        if self._native is not None:
+            self._native.close()
+        if self._py is not None:
+            self._py.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,220 @@
+"""Packed-record format, native reader parity, transforms, loader."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.data import (
+    DataLoader,
+    DistributedSampler,
+    PackedRecordReader,
+    PackedRecordWriter,
+    SyntheticImageClassification,
+)
+from pytorch_distributed_tpu.data import native
+from pytorch_distributed_tpu.data import transforms as T
+
+
+@pytest.fixture
+def tprc_file(tmp_path):
+    rng = np.random.default_rng(0)
+    records = [rng.bytes(int(n)) for n in rng.integers(1, 5000, size=50)]
+    records.append(b"")  # zero-length record edge case
+    path = str(tmp_path / "test.tprc")
+    with PackedRecordWriter(path) as w:
+        w.write_all(records)
+    return path, records
+
+
+def test_packed_record_roundtrip_python(tprc_file):
+    path, records = tprc_file
+    with PackedRecordReader(path, use_native=False) as r:
+        assert len(r) == len(records)
+        for i, rec in enumerate(records):
+            assert r.read(i) == rec
+        got = r.read_batch([3, 1, 4, 1, 5])
+        assert got == [records[3], records[1], records[4], records[1], records[5]]
+
+
+def test_native_reader_matches_python(tprc_file):
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    path, records = tprc_file
+    with PackedRecordReader(path, use_native=True) as r:
+        assert len(r) == len(records)
+        for i, rec in enumerate(records):
+            assert r.read(i) == rec
+        assert r.read_batch([0, 7, 2]) == [records[0], records[7], records[2]]
+
+
+def test_native_detects_corruption(tmp_path):
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    path = str(tmp_path / "c.tprc")
+    with PackedRecordWriter(path) as w:
+        w.write(b"hello world, a record long enough to corrupt")
+    # flip a payload byte (last byte of the file)
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with PackedRecordReader(path, use_native=True) as r:
+        with pytest.raises(IOError):
+            r.read(0)
+    with PackedRecordReader(path, use_native=False) as r:
+        with pytest.raises(IOError):
+            r.read(0)
+        assert r.read(0, verify_crc=False)  # corruption invisible without crc
+
+
+def test_transforms_shapes_and_ranges():
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    img = Image.fromarray(
+        rng.integers(0, 255, size=(300, 500, 3), dtype=np.uint8), "RGB"
+    )
+    train = T.train_transform(size=64)
+    out = train(img, np.random.default_rng(2))
+    assert out.shape == (64, 64, 3)
+    assert out.dtype == np.float32
+
+    ev = T.eval_transform(size=64, resize=72)
+    out2 = ev(img)
+    assert out2.shape == (64, 64, 3)
+    # eval transform is deterministic
+    np.testing.assert_array_equal(out2, ev(img))
+
+
+def test_center_crop_and_resize_geometry():
+    from PIL import Image
+
+    img = Image.new("RGB", (400, 200))
+    resized = T.Resize(100)(img)
+    assert (resized.width, resized.height) == (200, 100)  # short side → 100
+    cropped = T.CenterCrop(64)(resized)
+    assert (cropped.width, cropped.height) == (64, 64)
+
+
+def test_synthetic_dataset_deterministic():
+    ds = SyntheticImageClassification(size=16, image_size=8, num_classes=4)
+    img1, label1 = ds[3]
+    img2, label2 = ds[3]
+    np.testing.assert_array_equal(img1, img2)
+    assert label1 == label2 == 3
+    assert img1.shape == (8, 8, 3)
+
+
+@pytest.mark.parametrize("num_workers,prefetch", [(0, 1), (2, 3)])
+def test_loader_batches_and_seek(num_workers, prefetch):
+    ds = SyntheticImageClassification(size=40, image_size=4, num_classes=10)
+    sampler = DistributedSampler(len(ds), 2, 0, seed=1)
+    sampler.set_epoch(0)
+    loader = DataLoader(
+        ds, batch_size=4, sampler=sampler, num_workers=num_workers, prefetch=prefetch
+    )
+    batches = list(loader)
+    assert len(batches) == len(loader) == 5  # 20 local samples / bs 4
+    assert batches[0]["image"].shape == (4, 4, 4, 3)
+    assert batches[0]["label"].dtype == np.int32
+
+    # seek to batch 2: identical to slicing the full epoch (resume parity)
+    seeked = list(loader.iter_batches(start_batch=2))
+    assert len(seeked) == 3
+    for a, b in zip(seeked, batches[2:]):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_imagenet_packed_split(tmp_path):
+    from PIL import Image
+
+    from pytorch_distributed_tpu.data.imagenet import ImageNet, write_imagenet_split
+
+    rng = np.random.default_rng(3)
+
+    def samples():
+        for k in range(6):
+            img = Image.fromarray(
+                rng.integers(0, 255, size=(32, 48, 3), dtype=np.uint8), "RGB"
+            )
+            buf = io.BytesIO()
+            img.save(buf, "JPEG")
+            yield buf.getvalue(), k % 3
+
+    n = write_imagenet_split(str(tmp_path / "val.tprc"), samples())
+    assert n == 6
+    ds = ImageNet(
+        split="val",
+        data_dir=str(tmp_path),
+        transform=T.eval_transform(size=16, resize=20),
+    )
+    assert len(ds) == 6
+    img, label = ds[4]
+    assert img.shape == (16, 16, 3)
+    assert label == 1
+    loader = ds.loader(batch_size=3, num_workers=0)
+    batch = next(iter(loader))
+    assert batch["image"].shape == (3, 16, 16, 3)
+
+
+def test_writer_exception_publishes_nothing(tmp_path):
+    # A crash mid-pack must not leave a valid-looking partial file.
+    path = str(tmp_path / "crash.tprc")
+    with pytest.raises(RuntimeError):
+        with PackedRecordWriter(path) as w:
+            w.write(b"one")
+            raise RuntimeError("source iterator died")
+    assert not os.path.exists(path)
+    assert list(os.listdir(tmp_path)) == []  # no stray temp files
+
+
+def test_corrupt_record_count_native(tmp_path):
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    path = str(tmp_path / "bign.tprc")
+    with PackedRecordWriter(path) as w:
+        w.write(b"abc")
+    # corrupt n to a huge value: native open must fail cleanly, not abort
+    with open(path, "r+b") as f:
+        f.seek(8)
+        f.write((2**60).to_bytes(8, "little"))
+    with pytest.raises(IOError):
+        PackedRecordReader(path, use_native=True)
+
+
+def test_augmentation_rng_is_resume_deterministic(tmp_path):
+    """Resumed iteration must reproduce the same random crops/flips."""
+    import io as _io
+
+    from PIL import Image
+
+    from pytorch_distributed_tpu.data.imagenet import ImageNet, write_imagenet_split
+
+    rng = np.random.default_rng(5)
+
+    def samples():
+        for k in range(8):
+            img = Image.fromarray(
+                rng.integers(0, 255, size=(40, 40, 3), dtype=np.uint8), "RGB"
+            )
+            buf = _io.BytesIO()
+            img.save(buf, "JPEG")
+            yield buf.getvalue(), k
+
+    write_imagenet_split(str(tmp_path / "train.tprc"), samples())
+    ds = ImageNet(
+        split="train",
+        data_dir=str(tmp_path),
+        transform=T.train_transform(size=16),  # random crop + flip
+    )
+    sampler = DistributedSampler(len(ds), 1, 0, seed=2)
+    sampler.set_epoch(1)
+    loader = DataLoader(ds, batch_size=2, sampler=sampler, num_workers=0, seed=9)
+    full = list(loader)
+    resumed = list(loader.iter_batches(start_batch=2))
+    for a, b in zip(resumed, full[2:]):
+        np.testing.assert_array_equal(a["image"], b["image"])  # same augmentations
