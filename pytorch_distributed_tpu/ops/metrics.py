@@ -48,8 +48,11 @@ class ClassificationMetrics:
 
     @classmethod
     def empty(cls) -> "ClassificationMetrics":
-        zero = jnp.zeros((), jnp.float32)
-        return cls(loss_sum=zero, correct1=zero, correct5=zero, count=zero)
+        # Four distinct buffers: the eval step donates this pytree, and
+        # aliasing one zero array into all fields would donate the same
+        # buffer twice (XLA INVALID_ARGUMENT).
+        zeros = (jnp.zeros((), jnp.float32) for _ in range(4))
+        return cls(*zeros)
 
     @classmethod
     def from_step(

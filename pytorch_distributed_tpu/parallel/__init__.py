@@ -1,0 +1,47 @@
+from pytorch_distributed_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    global_batch_size,
+    local_mesh,
+    local_replica_count,
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+    single_device_mesh,
+)
+from pytorch_distributed_tpu.parallel.distributed import (
+    barrier,
+    get_rank,
+    get_world_size,
+    init_process_group,
+    is_primary,
+)
+from pytorch_distributed_tpu.parallel.collectives import (
+    all_reduce,
+    broadcast_from_primary,
+    pmean_tree,
+    psum_tree,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "single_device_mesh",
+    "local_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "global_batch_size",
+    "local_replica_count",
+    "init_process_group",
+    "get_rank",
+    "get_world_size",
+    "is_primary",
+    "barrier",
+    "all_reduce",
+    "broadcast_from_primary",
+    "psum_tree",
+    "pmean_tree",
+]

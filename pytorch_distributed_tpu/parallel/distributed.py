@@ -1,0 +1,116 @@
+"""Multi-host rendezvous — TPU-native process-group bootstrap.
+
+Replaces ``dist.init_process_group(backend='nccl',
+init_method=f'tcp://{ip}:{port}', world_size=hosts*gpus,
+rank=rank*gpus+local_rank)`` (``restnet_ddp.py:87-94``) with
+``jax.distributed.initialize``: the JAX coordination service plays the role
+of the TCPStore rendezvous, and there is no backend string — collectives are
+chosen by XLA from the mesh (ICI within a pod, DCN across pods).
+
+Env-var contract (kept compatible with the reference, ``restnet_ddp.py:87-90``,
+including its quirk that WORLD_SIZE counts *nodes* and RANK is the *node
+index* — on TPU one process per host is the native model, so node == process
+and the reference's ``rank*gpus+local_rank`` arithmetic disappears, D11):
+
+    MASTER_IP / MASTER_PORT   coordinator address   (ref restnet_ddp.py:87-88)
+    WORLD_SIZE                number of hosts       (ref restnet_ddp.py:89)
+    RANK                      this host's index     (ref restnet_ddp.py:90)
+
+On TPU pods all three are auto-discoverable; ``init_process_group()`` with
+no env set degrades to single-process, so every recipe runs unchanged from a
+laptop CPU to a multi-pod slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("pytorch_distributed_tpu")
+
+_initialized = False
+
+
+def init_process_group(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the job's coordination service (idempotent).
+
+    Arguments default from the reference's env contract (module docstring);
+    with nothing set and nothing auto-detectable this is a no-op and the
+    process runs single-host (≙ ``resnet_single_gpu.py`` / ``resnet_dp.py``,
+    which never call ``init_process_group``).
+    """
+    global _initialized
+    if _initialized:
+        return
+
+    ip = os.environ.get("MASTER_IP")
+    port = os.environ.get("MASTER_PORT")
+    if coordinator_address is None and ip and port:
+        coordinator_address = f"{ip}:{port}"
+    if num_processes is None and os.environ.get("WORLD_SIZE"):
+        num_processes = int(os.environ["WORLD_SIZE"])
+    if process_id is None and os.environ.get("RANK"):
+        process_id = int(os.environ["RANK"])
+
+    if coordinator_address is None and num_processes is None:
+        # Single-host path, or a TPU pod where JAX auto-discovers topology
+        # from the metadata server. Only call initialize when we're actually
+        # on a multi-host TPU runtime; otherwise stay single-process.
+        if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            jax.distributed.initialize()
+            _initialized = True
+            logger.info(
+                "auto-initialized: process %d/%d", jax.process_index(), jax.process_count()
+            )
+        return
+
+    if num_processes is not None and num_processes <= 1:
+        return
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "rendezvous complete at %s: process %d/%d, %d local / %d global devices",
+        coordinator_address,
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def get_rank() -> int:
+    """This host's process index (ref ``dist.get_rank()``, but per-host: one
+    process drives all local chips, so there is no local_rank)."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Number of processes (ref ``dist.get_world_size()`` counted GPUs; here
+    hosts — chip count is ``jax.device_count()``)."""
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """Rank-0 gate for printing/checkpointing (ref ``rank == 0 and
+    local_rank == 0``, ``restnet_ddp.py:36,66,145``)."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point (the reference has no
+    explicit barrier; NCCL collectives gave it implicit sync)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

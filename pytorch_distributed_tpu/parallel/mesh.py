@@ -1,0 +1,121 @@
+"""Device mesh construction and sharding specs — the communication layer.
+
+TPU-native replacement for the reference's NCCL process-group + DDP wrapper
+stack (D6/D7/D13: ``dist.init_process_group('nccl', ...)``,
+``restnet_ddp.py:94``; ``DistributedDataParallel(model.cuda())``,
+``restnet_ddp.py:99``). There is no wrapper object here: parallelism is a
+``jax.sharding.Mesh`` plus sharding specs on one SPMD step function. XLA
+compiles the gradient all-reduce into the step program and routes it over
+ICI (intra-pod) / DCN (cross-pod) automatically.
+
+The mesh always carries a ``data`` axis (the only one the reference's
+capability surface uses — all three DP flavors map onto it) and optionally a
+``model`` axis, left addable per SURVEY.md §2c so tensor parallelism is a
+sharding-spec change, not a redesign.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data_parallel: Optional[int] = None,
+    model_parallel: int = 1,
+    axis_names: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
+) -> Mesh:
+    """Build a (data, model) mesh over the given (default: all) devices.
+
+    With ``model_parallel=1`` (the reference's entire capability surface)
+    this is a pure data-parallel mesh: one replica per chip, the exact
+    topology ``DistributedDataParallel`` builds with one process per GPU
+    (``restnet_ddp.py:154-155``) — minus the processes: a single program
+    spans every chip on every host.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if data_parallel is None:
+        if n % model_parallel:
+            raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+        data_parallel = n // model_parallel
+    if data_parallel * model_parallel != n:
+        raise ValueError(
+            f"mesh {data_parallel}x{model_parallel} != {n} devices"
+        )
+    grid = np.asarray(devices).reshape(data_parallel, model_parallel)
+    return Mesh(grid, axis_names=tuple(axis_names))
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    """1-chip mesh: the ``resnet_single_gpu.py`` topology. The same SPMD
+    step function runs unchanged; collectives over a size-1 axis are no-ops."""
+    if device is None:
+        device = jax.devices()[0]
+    return make_mesh([device])
+
+
+def local_mesh() -> Mesh:
+    """All chips addressable by this process (the ``nn.DataParallel``
+    topology, ``resnet_dp.py:82`` — 8 local devices, one process)."""
+    return make_mesh(jax.local_devices())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Leading (batch) dimension split across the data axis — how every
+    input batch is laid out. Per-replica shard size ≙ the reference's
+    per-process batch of 400 (``restnet_ddp.py:78``)."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated — parameters and optimizer state in pure DP.
+
+    ≙ DDP's broadcast-from-rank-0 at construction (``restnet_ddp.py:99``):
+    placing the initial pytree with this sharding performs the broadcast.
+    """
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS):
+    """Place a host-local numpy batch onto the mesh as a global array.
+
+    Each process passes its local shard (what its DataLoader produced for
+    its ranks); together they form the global batch. Replaces the per-step
+    H2D copy ``x.cuda(non_blocking=True)`` (``restnet_ddp.py:25``) — the
+    transfer is async and the result is already laid out for the compiled
+    step, so no scatter happens at step time (unlike ``nn.DataParallel``'s
+    per-step scatter, D5).
+    """
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )
+
+
+def global_batch_size(mesh: Mesh, per_replica_batch: int, axis: str = DATA_AXIS) -> int:
+    """per-replica bs × data-axis size (ref: 400 × world_size)."""
+    return per_replica_batch * mesh.shape[axis]
+
+
+def local_replica_count(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    """How many data-axis replicas this process feeds (= local chips / model
+    axis span). The loader produces ``local_replica_count × per_replica_bs``
+    samples per step."""
+    local = set(jax.local_devices())
+    axis_index = mesh.axis_names.index(axis)
+    coords = set()
+    for idx in np.ndindex(mesh.devices.shape):
+        if mesh.devices[idx] in local:
+            coords.add(idx[axis_index])
+    return max(len(coords), 1)

@@ -1,0 +1,11 @@
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.step import make_eval_step, make_train_step
+from pytorch_distributed_tpu.train.trainer import Trainer, TrainerConfig
+
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "Trainer",
+    "TrainerConfig",
+]

@@ -1,0 +1,68 @@
+"""Training state: one immutable pytree holding everything a step mutates.
+
+The canonical checkpoint layout shared by every recipe (SURVEY.md §5:
+the reference keeps ``latest.pt`` interchangeable across all four scripts by
+always saving the unwrapped ``model.module.state_dict()``,
+``restnet_ddp.py:37-44``). Here the equivalent invariant is: TrainState has
+the same tree structure in every parallelism mode — only the sharding
+differs — so a checkpoint from a single-chip run restores onto a pod and
+vice versa.
+
+Contents mirror the reference's checkpoint dict:
+  params/batch_stats ≙ ``model.state_dict()``; opt_state ≙ ``optimizer``
+  (and, because LR schedules are pure functions of the step count inside
+  opt_state, also ≙ ``scheduler``); step ≙ ``step``; scaler ≙ the AMP
+  GradScaler state (``resnet_ddp_apex.py:44``). ``epoch``/``best_acc`` are
+  host-side loop state, stored next to this pytree by the checkpointer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from pytorch_distributed_tpu.ops.precision import NoOpLossScaler
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Immutable step state; ``apply_fn``/``tx`` are static (not checkpointed)."""
+
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    scaler: Any
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(
+        cls,
+        model,
+        tx: optax.GradientTransformation,
+        rng: jax.Array,
+        input_shape,
+        scaler: Optional[Any] = None,
+    ) -> "TrainState":
+        """Initialize from a flax module (≙ constructing model+optimizer,
+        ``restnet_ddp.py:98,122``)."""
+        variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+            scaler=scaler if scaler is not None else NoOpLossScaler.create(),
+            apply_fn=model.apply,
+            tx=tx,
+        )
+
+    def param_count(self) -> int:
+        return sum(int(jnp.size(p)) for p in jax.tree.leaves(self.params))

@@ -1,0 +1,278 @@
+"""The trainer: one SPMD loop serving all four reference recipes.
+
+The reference implements the same epoch loop four times (SURVEY.md §2a, R1-R4)
+— the scripts differ only in how replicas communicate. Here the loop exists
+once and the communication mode is the ``Mesh`` passed in:
+
+    1-device mesh          ≙ resnet_single_gpu.py
+    local 8-chip mesh      ≙ resnet_dp.py        (without D5's scatter cost)
+    multi-host mesh        ≙ restnet_ddp.py      (rendezvous via parallel.init_process_group)
+    + precision="bf16"     ≙ resnet_ddp_apex.py  (no scaler needed on TPU)
+
+Reproduced behaviors (each is a cited shared behavior from SURVEY.md §2a):
+epoch loop with ``set_epoch`` reshuffle (``restnet_ddp.py:135-137``),
+mid-epoch step resume — seekable, not read-and-discard
+(``restnet_ddp.py:22-23`` improved per §3.5), suspend poll → checkpoint →
+yield (``restnet_ddp.py:36-47``), resume-load restoring
+model/optimizer/scheduler/best_acc/epoch/step (``restnet_ddp.py:127-132``),
+per-epoch validation with cross-replica reduction (``restnet_ddp.py:50-70``),
+best-checkpoint tracking (``restnet_ddp.py:145-150``), epoch timing log
+(``restnet_ddp.py:136-146``), step-progress log every 100 steps
+(``resnet_single_gpu.py:23-24``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from pytorch_distributed_tpu.ops.metrics import ClassificationMetrics
+from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
+from pytorch_distributed_tpu.ops.precision import DynamicLossScaler, NoOpLossScaler
+from pytorch_distributed_tpu.ops.schedules import step_lr
+from pytorch_distributed_tpu.parallel import collectives, mesh as mesh_lib
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.step import make_eval_step, make_train_step
+from pytorch_distributed_tpu.utils.checkpoint import Checkpointer
+from pytorch_distributed_tpu.utils.logging import rank0_print
+from pytorch_distributed_tpu.utils.suspend import NullSuspendWatcher, SuspendWatcher
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """Hyperparameters, defaulted to the reference's hardcoded values
+    (``restnet_ddp.py:77-83``, ``resnet_single_gpu.py:107-109``)."""
+
+    epochs: int = 100
+    batch_size: int = 400  # per data-replica, like DDP's per-process bs
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    lr_step_epochs: int = 30
+    lr_gamma: float = 0.1
+    precision: str = "fp32"  # fp32 | bf16 | fp16 (fp16 adds a dynamic scaler)
+    label_smoothing: float = 0.0
+    save_dir: str = "output"
+    log_every: int = 100  # ref resnet_single_gpu.py:23
+    num_workers: int = 8
+    prefetch: int = 2
+    seed: int = 0
+    # multi-host suspend agreement: how often (steps) non-primary hosts learn
+    # of a primary-side suspend; 1 = every step (exact reference semantics,
+    # one tiny DCN broadcast per step), 0 = primary-only like the reference.
+    suspend_sync_every: int = 0
+
+
+class Trainer:
+    """Drives (model, datasets) over a mesh with the config's recipe."""
+
+    def __init__(
+        self,
+        model,
+        train_dataset,
+        val_dataset,
+        config: TrainerConfig,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        suspend_watcher: Optional[SuspendWatcher] = None,
+        input_shape=(1, 224, 224, 3),
+    ):
+        from pytorch_distributed_tpu.data import DataLoader, DistributedSampler
+
+        self.config = config
+        self.model = model
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        self.watcher = suspend_watcher or NullSuspendWatcher()
+        self.ckpt = Checkpointer(config.save_dir)
+
+        # Each process loads the shard its local chips will consume: sampler
+        # splits by host (D10 semantics), loader batches local_replicas × bs.
+        n_local = mesh_lib.local_replica_count(self.mesh)
+        local_batch = config.batch_size * n_local
+        self.train_sampler = DistributedSampler(
+            len(train_dataset),
+            num_replicas=jax.process_count(),
+            rank=jax.process_index(),
+            shuffle=True,
+            seed=config.seed,
+        )
+        self.val_sampler = DistributedSampler(
+            len(val_dataset),
+            num_replicas=jax.process_count(),
+            rank=jax.process_index(),
+            shuffle=False,
+            seed=config.seed,
+        )
+        self.train_loader = DataLoader(
+            train_dataset,
+            batch_size=local_batch,
+            sampler=self.train_sampler,
+            num_workers=config.num_workers,
+            drop_last=True,
+            prefetch=config.prefetch,
+            seed=config.seed,
+        )
+        self.val_loader = DataLoader(
+            val_dataset,
+            batch_size=local_batch,
+            sampler=self.val_sampler,
+            num_workers=config.num_workers,
+            drop_last=False,
+            prefetch=config.prefetch,
+            seed=config.seed,
+        )
+
+        steps_per_epoch = len(self.train_loader)
+        schedule = step_lr(
+            config.lr,
+            steps_per_epoch,
+            step_size_epochs=config.lr_step_epochs,
+            gamma=config.lr_gamma,
+        )
+        tx = sgd_with_weight_decay(
+            schedule, momentum=config.momentum, weight_decay=config.weight_decay
+        )
+        scaler = (
+            DynamicLossScaler.create()
+            if config.precision == "fp16"
+            else NoOpLossScaler.create()
+        )
+        state = TrainState.create(
+            model, tx, jax.random.key(config.seed), input_shape, scaler=scaler
+        )
+        # Replicated placement ≙ DDP's broadcast-from-rank-0 (restnet_ddp.py:99).
+        self.state = jax.device_put(
+            state, mesh_lib.replicated_sharding(self.mesh)
+        )
+
+        self.train_step = make_train_step(
+            self.mesh, label_smoothing=config.label_smoothing
+        )
+        self.eval_step = make_eval_step(self.mesh)
+
+        self.best_acc = 0.0
+        self.start_epoch = 0
+        self.start_step = 0
+
+    # ---- checkpoint contract (SURVEY.md §3.5) ----
+
+    def _payload(self, epoch: int, step: int) -> dict:
+        return {
+            "state": self.state,
+            "epoch": epoch,
+            "step": step,
+            "best_acc": self.best_acc,
+        }
+
+    def try_resume(self) -> bool:
+        """Restore from ``latest.ckpt`` if present (ref ``restnet_ddp.py:127-132``)."""
+        if not self.ckpt.has_latest():
+            return False
+        restored = self.ckpt.load_latest(self._payload(0, 0))
+        self.state = jax.device_put(
+            restored["state"], mesh_lib.replicated_sharding(self.mesh)
+        )
+        self.start_epoch = int(restored["epoch"])
+        self.start_step = int(restored["step"])
+        self.best_acc = float(restored["best_acc"])
+        rank0_print(
+            f"resumed from {self.ckpt.latest_path}: "
+            f"epoch {self.start_epoch} step {self.start_step} best_acc {self.best_acc:.2f}"
+        )
+        return True
+
+    def _maybe_suspend(self, epoch: int, step: int) -> None:
+        """Poll → checkpoint → yield (ref ``restnet_ddp.py:36-47``). Fixes the
+        reference's stale-best_acc bug (SURVEY.md §2a defects): the payload
+        reads the trainer's live best_acc, not an epoch-start copy."""
+        suspended = self.watcher.receive_suspend_command()
+        sync = self.config.suspend_sync_every
+        if sync and jax.process_count() > 1 and step % sync == 0:
+            # Any-reduce, not primary-broadcast: a preemption signal landing
+            # on any single host must make every host checkpoint and yield
+            # together, or the survivors deadlock in the next collective.
+            suspended = bool(
+                collectives.all_reduce(np.float32(suspended), "max")
+            )
+        if not suspended:
+            return
+        if jax.process_index() == 0:
+            self.ckpt.save_latest(self._payload(epoch, step + 1))
+            rank0_print(f"suspend: saved {self.ckpt.latest_path} at epoch {epoch} step {step}")
+        self.ckpt.wait()
+        self.watcher.go_suspend()
+
+    # ---- the loops ----
+
+    def train_epoch(self, epoch: int, start_step: int = 0) -> dict:
+        """One training epoch (ref ``train``, ``restnet_ddp.py:19-47``)."""
+        cfg = self.config
+        last = {}
+        for step, host_batch in enumerate(
+            self.train_loader.iter_batches(start_step), start=start_step
+        ):
+            batch = mesh_lib.shard_batch(self.mesh, host_batch)
+            self.state, metrics = self.train_step(self.state, batch)
+            if cfg.log_every and step % cfg.log_every == 0:
+                last = {k: float(v) for k, v in metrics.items()}
+                rank0_print(
+                    f"epoch {epoch} step {step}: loss {last['loss']:.4f} "
+                    f"acc1 {100.0 * last['correct1'] / max(last['count'], 1):.2f}"
+                )
+            self._maybe_suspend(epoch, step)
+        return last
+
+    def validate(self) -> dict:
+        """Validation epoch (ref ``validate``, ``restnet_ddp.py:50-72``):
+        device-resident accumulators, one global psum'd result on every host."""
+        metrics = jax.device_put(
+            ClassificationMetrics.empty(), mesh_lib.replicated_sharding(self.mesh)
+        )
+        n_local = mesh_lib.local_replica_count(self.mesh)
+        for host_batch in self.val_loader.iter_batches(0):
+            # Wrap-pad a partial final batch to replica divisibility — the
+            # same duplicate-counting semantics torch's non-drop_last
+            # DistributedSampler gives the reference's val loop
+            # (restnet_ddp.py:118, D10 padding).
+            n = host_batch["image"].shape[0]
+            pad = (-n) % n_local
+            if pad:
+                # np.resize tiles cyclically, so pad > n (tiny final batch,
+                # many replicas) still fills correctly.
+                host_batch = {
+                    k: np.resize(v, (n + pad,) + v.shape[1:])
+                    for k, v in host_batch.items()
+                }
+            batch = mesh_lib.shard_batch(self.mesh, host_batch)
+            metrics = self.eval_step(self.state, batch, metrics)
+        return jax.device_get(metrics).summary()
+
+    def fit(self) -> dict:
+        """Full run: resume → epochs → validate → best tracking → timing
+        (ref ``main`` of every recipe, e.g. ``restnet_ddp.py:135-150``)."""
+        self.try_resume()
+        summary: dict = {}
+        for epoch in range(self.start_epoch, self.config.epochs):
+            t0 = time.time()
+            self.train_sampler.set_epoch(epoch)  # ref restnet_ddp.py:137
+            start_step = self.start_step if epoch == self.start_epoch else 0
+            self.train_epoch(epoch, start_step)
+            summary = self.validate()
+            rank0_print(
+                f"epoch {epoch}: val loss {summary['loss']:.4f} "
+                f"acc1 {summary['acc1']:.2f} acc5 {summary['acc5']:.2f}"
+            )
+            if summary["acc1"] > self.best_acc:
+                self.best_acc = summary["acc1"]
+                if jax.process_index() == 0:
+                    self.ckpt.save_best(self._payload(epoch + 1, 0))
+                rank0_print(f"new best acc1 {self.best_acc:.2f}, saved best.ckpt")
+            rank0_print(
+                f"epoch {epoch} cost time: {time.time() - t0:.1f} s"
+            )  # ref restnet_ddp.py:146
+        self.start_step = 0
+        summary["best_acc"] = self.best_acc
+        return summary

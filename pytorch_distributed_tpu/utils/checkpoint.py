@@ -1,0 +1,116 @@
+"""Checkpoint serialization and the latest/best artifact contract.
+
+Replaces ``torch.save(state, 'latest.pt')`` / ``torch.load(...,
+map_location='cpu')`` (D4; ``restnet_ddp.py:45,127-132,150``) with an atomic
+msgpack pytree checkpoint:
+
+- one canonical layout shared by every parallelism mode (the reference keeps
+  this invariant by always saving the unwrapped ``model.module.state_dict()``,
+  ``restnet_ddp.py:38``): ``{state: TrainState pytree, epoch, step,
+  best_acc}`` — restores from a 1-chip run onto a pod and back;
+- atomic: write to a temp file in the same directory, fsync, rename — a
+  preemption mid-write can never corrupt ``latest.ckpt`` (torch.save has the
+  same failure mode the reference ignores);
+- rank-0-gated by the caller (ref ``restnet_ddp.py:36,145``) — parameters
+  are replicated, so one host's copy is the global truth;
+- optional background-thread save so the step loop doesn't stall on disk
+  (the suspend path saves synchronously — it's about to yield anyway).
+
+Artifacts mirror the reference: ``latest.ckpt`` = full training state,
+written on suspend (not periodic — same policy, SURVEY.md §5);
+``best.ckpt`` = written on validation improvement (``restnet_ddp.py:145-150``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+LATEST = "latest.ckpt"
+BEST = "best.ckpt"
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_checkpoint(path: str | os.PathLike, payload: Any) -> None:
+    """Atomically serialize a pytree payload to ``path``."""
+    path = os.fspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state_dict = serialization.to_state_dict(_to_host(payload))
+    blob = serialization.msgpack_serialize(state_dict)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str | os.PathLike, template: Any) -> Any:
+    """Restore a payload saved by ``save_checkpoint`` into the structure of
+    ``template`` (≙ ``load_state_dict``, ``restnet_ddp.py:128-132``).
+    Arrays come back as numpy on host — the trainer re-places them onto the
+    mesh with the right sharding (≙ ``map_location='cpu'`` then ``.cuda()``).
+    """
+    with open(os.fspath(path), "rb") as f:
+        state_dict = serialization.msgpack_restore(f.read())
+    return serialization.from_state_dict(template, state_dict)
+
+
+class Checkpointer:
+    """latest/best artifact manager for a save directory.
+
+    ``save_latest`` optionally runs in a background thread (``wait()`` to
+    join — the suspend path does); ``save_best`` is called on metric
+    improvement only, like ``restnet_ddp.py:145-150``.
+    """
+
+    def __init__(self, save_dir: str | os.PathLike):
+        self.save_dir = os.fspath(save_dir)
+        self._thread: Optional[threading.Thread] = None
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.save_dir, name)
+
+    @property
+    def latest_path(self) -> str:
+        return self._path(LATEST)
+
+    @property
+    def best_path(self) -> str:
+        return self._path(BEST)
+
+    def has_latest(self) -> bool:
+        return os.path.exists(self.latest_path)
+
+    def save_latest(self, payload: Any, block: bool = True) -> None:
+        if block:
+            save_checkpoint(self.latest_path, payload)
+            return
+        payload = _to_host(payload)  # snapshot before handing to the thread
+        self.wait()
+        self._thread = threading.Thread(
+            target=save_checkpoint, args=(self.latest_path, payload), daemon=True
+        )
+        self._thread.start()
+
+    def save_best(self, payload: Any) -> None:
+        save_checkpoint(self.best_path, payload)
+
+    def load_latest(self, template: Any) -> Any:
+        return load_checkpoint(self.latest_path, template)
+
+    def load_best(self, template: Any) -> Any:
+        return load_checkpoint(self.best_path, template)
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
