@@ -61,9 +61,19 @@ def init_process_group(
 
     if coordinator_address is None and num_processes is None:
         # Single-host path, or a TPU pod where JAX auto-discovers topology
-        # from the metadata server. Only call initialize when we're actually
-        # on a multi-host TPU runtime; otherwise stay single-process.
-        if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        # from the metadata server. Only call initialize on a genuinely
+        # multi-worker runtime (single-worker setups — including tunneled
+        # dev chips that advertise TPU_WORKER_HOSTNAMES=localhost — stay
+        # single-process).
+        workers = [
+            h
+            for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+            if h.strip()
+        ]
+        if len(workers) > 1 or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            # Fail loudly: silently degrading a multi-worker job to N
+            # independent single-process trainers would have every host
+            # believe it is primary and clobber shared checkpoints.
             jax.distributed.initialize()
             _initialized = True
             logger.info(

@@ -1,0 +1,126 @@
+"""Shared recipe scaffolding.
+
+The reference implements its epoch/val/suspend loop four times (SURVEY.md
+§2a R1-R4); here each recipe is a Mesh + a TrainerConfig over the one SPMD
+trainer. This module holds the pieces every recipe shares: the hardcoded
+reference hyperparameters (``restnet_ddp.py:77-83``), dataset construction
+(real TPRC ImageNet or the synthetic stand-in), and the run function.
+
+Recipes keep the reference's zero-required-args ergonomics (`python
+recipes/resnet_ddp.py`); ``--synthetic`` / ``--tiny`` exist so every recipe
+also runs as a smoke test on a laptop CPU (SURVEY.md §4 — the reference can
+only validate on its real cluster; we refuse to inherit that).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# `python recipes/<recipe>.py` puts recipes/ (not the repo root) on sys.path;
+# make the package importable without an install.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# Pin the environment BEFORE jax is imported: jax binds env-var-driven config
+# defaults (e.g. JAX_COMPILATION_CACHE_DIR, which set_env establishes) at
+# import time. The recipes' own set_env calls then find it already active.
+from pytorch_distributed_tpu.utils.env import set_env
+
+set_env("202607")
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.models import resnet50
+from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+from pytorch_distributed_tpu.parallel import global_batch_size
+from pytorch_distributed_tpu.train import Trainer, TrainerConfig
+from pytorch_distributed_tpu.utils.logging import rank0_print
+from pytorch_distributed_tpu.utils.suspend import SuspendWatcher
+
+
+def parse_args(description: str) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--synthetic", action="store_true",
+                   help="synthetic data instead of TPRC ImageNet")
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny model/epochs for smoke-testing on CPU")
+    p.add_argument("--data-dir", default=None, help="TPRC ImageNet directory")
+    p.add_argument("--save-dir", default="output", help="checkpoint directory")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="per-replica batch size (ref default 400)")
+    return p.parse_args()
+
+
+def build_datasets(args):
+    if args.synthetic or args.tiny:
+        from pytorch_distributed_tpu.data import SyntheticImageClassification
+
+        size = 16 if args.tiny else 224
+        n_train, n_val = (256, 64) if args.tiny else (8192, 1024)
+        classes = 10 if args.tiny else 1000
+        return (
+            SyntheticImageClassification(n_train, size, classes),
+            SyntheticImageClassification(n_val, size, classes, seed=1),
+            size,
+            classes,
+        )
+    from pytorch_distributed_tpu.data.imagenet import DEFAULT_DATA_DIR, ImageNet
+
+    data_dir = args.data_dir or DEFAULT_DATA_DIR
+    # ref: hfai.datasets.ImageNet('train'/'val', transform), restnet_ddp.py:107,117
+    return (
+        ImageNet("train", data_dir=data_dir),
+        ImageNet("val", data_dir=data_dir),
+        224,
+        1000,
+    )
+
+
+def build_model(args, num_classes: int, precision: str):
+    dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+    if args.tiny:
+        return ResNet(stage_sizes=(1, 1), block_cls=BasicBlock,
+                      num_classes=num_classes, num_filters=8, dtype=dtype)
+    # ref: torchvision.models.resnet50(), restnet_ddp.py:98
+    return resnet50(num_classes=num_classes, dtype=dtype)
+
+
+def run(args, mesh, precision: str = "fp32") -> dict:
+    """Build everything and fit — the body shared by all four recipes."""
+    train_ds, val_ds, image_size, num_classes = build_datasets(args)
+    model = build_model(args, num_classes, precision)
+    cfg = TrainerConfig(
+        # ref hyperparameters: restnet_ddp.py:77-83, resnet_single_gpu.py:107-109
+        epochs=args.epochs if args.epochs is not None else (2 if args.tiny else 100),
+        batch_size=args.batch_size if args.batch_size is not None else (4 if args.tiny else 400),
+        lr=0.1 if not args.tiny else 0.05,
+        momentum=0.9,
+        weight_decay=1e-4,
+        lr_step_epochs=30,
+        lr_gamma=0.1,
+        precision=precision,
+        save_dir=args.save_dir,
+        num_workers=0 if args.tiny else 8,
+    )
+    trainer = Trainer(
+        model,
+        train_ds,
+        val_ds,
+        cfg,
+        mesh=mesh,
+        suspend_watcher=SuspendWatcher(),
+        input_shape=(1, image_size, image_size, 3),
+    )
+    rank0_print(
+        f"devices: {jax.device_count()} ({jax.process_count()} hosts), "
+        f"mesh {dict(mesh.shape)}, global batch "
+        f"{global_batch_size(mesh, cfg.batch_size)}, precision {precision}"
+    )
+    summary = trainer.fit()
+    rank0_print(f"done: best acc1 {summary.get('best_acc', 0.0):.2f}")
+    return summary

@@ -1,0 +1,30 @@
+"""ResNet-50/ImageNet, multi-process/multi-host data parallel —
+≙ ``restnet_ddp.py`` (R3; the reference repo's filename typo is theirs).
+
+The reference forks 8 NUMA-bound processes per node, TCP-rendezvouses a
+NCCL process group, and wraps the model in DistributedDataParallel
+(``restnet_ddp.py:87-99,154-155``). Here: one process per host joins the
+JAX coordination service (same MASTER_IP/MASTER_PORT/WORLD_SIZE/RANK env
+contract), and the mesh spans every chip in the job — gradient all-reduce
+compiles into the step and rides ICI/DCN.
+
+Single host, no env vars → runs on the local chips (still the DDP recipe,
+world of one).
+
+    MASTER_IP=… MASTER_PORT=… WORLD_SIZE=<hosts> RANK=<host_idx> \
+        python recipes/resnet_ddp.py          # on every host
+"""
+
+from common import parse_args, run  # noqa: E402  (bootstraps sys.path)
+
+import pytorch_distributed_tpu as pdt
+
+pdt.set_env("202607")
+
+from pytorch_distributed_tpu.parallel import init_process_group, make_mesh  # noqa: E402
+
+
+if __name__ == "__main__":
+    args = parse_args(__doc__)
+    init_process_group()  # ≙ dist.init_process_group('nccl', ...), restnet_ddp.py:94
+    run(args, make_mesh(), precision="fp32")

@@ -1,0 +1,26 @@
+"""ResNet-50/ImageNet, DDP + mixed precision — ≙ ``resnet_ddp_apex.py`` (R4).
+
+The reference runs fp16 under ``torch.cuda.amp.autocast`` with a dynamic
+loss scaler (``resnet_ddp_apex.py:27-33,107``) — its fastest config
+(230.98 s/epoch, BASELINE.md). On TPU mixed precision is bf16 on the MXU:
+fp32-range exponent means no scaler is needed, so "AMP" here is just the
+bf16 compute policy on the same trainer (pass precision=fp16 via code to get
+a real dynamic-scaler run for parity experiments).
+
+    MASTER_IP=… MASTER_PORT=… WORLD_SIZE=<hosts> RANK=<host_idx> \
+        python recipes/resnet_ddp_amp.py      # on every host
+"""
+
+from common import parse_args, run  # noqa: E402  (bootstraps sys.path)
+
+import pytorch_distributed_tpu as pdt
+
+pdt.set_env("202607")
+
+from pytorch_distributed_tpu.parallel import init_process_group, make_mesh  # noqa: E402
+
+
+if __name__ == "__main__":
+    args = parse_args(__doc__)
+    init_process_group()
+    run(args, make_mesh(), precision="bf16")

@@ -1,0 +1,22 @@
+"""ResNet-50/ImageNet on a single chip — ≙ ``resnet_single_gpu.py`` (R1).
+
+fp32 baseline: one device, bs 400, SGD(0.1, momentum 0.9, wd 1e-4),
+StepLR(30, 0.1), 100 epochs, per-epoch validation, suspend/resume
+(``resnet_single_gpu.py:69-134``). Same trainer as every other recipe; the
+mesh is just one chip.
+
+    python recipes/resnet_single.py [--synthetic] [--tiny]
+"""
+
+from common import parse_args, run  # noqa: E402  (bootstraps sys.path)
+
+import pytorch_distributed_tpu as pdt
+
+pdt.set_env("202607")  # ≙ hf_env.set_env('202111'), every ref script lines 1-2
+
+from pytorch_distributed_tpu.parallel import single_device_mesh  # noqa: E402
+
+
+if __name__ == "__main__":
+    args = parse_args(__doc__)
+    run(args, single_device_mesh(), precision="fp32")
