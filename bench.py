@@ -13,9 +13,9 @@ single-device comparison; the reference's own best AMP 8-GPU config averages
 ≈693 img/s per GPU, so vs_baseline ≳ 1 also implies per-chip parity with
 their headline config.
 
-Batch size: 256 by default (fits v5e 16 GB HBM), halved automatically on
-RESOURCE_EXHAUSTED; override with BENCH_BS. BENCH_TINY=1 runs a toy model
-for CI/CPU smoke.
+Batch size: 128 by default (best measured on v5e; see the sweep comment in
+main()), halved automatically on RESOURCE_EXHAUSTED; override with
+BENCH_BS. BENCH_TINY=1 runs a toy model for CI/CPU smoke.
 """
 
 from __future__ import annotations
@@ -93,7 +93,8 @@ def run(batch_size: int, tiny: bool, warmup: int = 10, iters: int = 30) -> float
 
 def main() -> None:
     tiny = os.environ.get("BENCH_TINY", "") == "1"
-    batch_size = int(os.environ.get("BENCH_BS", "64" if tiny else "256"))
+    # bs sweep on v5e (2026-07): 128 → 2590 img/s, 256 → 2540, 512 → 2414.
+    batch_size = int(os.environ.get("BENCH_BS", "64" if tiny else "128"))
     if batch_size < 1:
         raise ValueError(f"BENCH_BS must be >= 1, got {batch_size}")
     while True:

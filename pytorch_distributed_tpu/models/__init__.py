@@ -1,3 +1,8 @@
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    tiny_config,
+)
 from pytorch_distributed_tpu.models.resnet import (
     ResNet,
     resnet18,
@@ -8,6 +13,9 @@ from pytorch_distributed_tpu.models.resnet import (
 )
 
 __all__ = [
+    "TransformerConfig",
+    "TransformerLM",
+    "tiny_config",
     "ResNet",
     "resnet18",
     "resnet34",

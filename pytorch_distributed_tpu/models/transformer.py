@@ -1,0 +1,133 @@
+"""Causal transformer LM, designed for sequence parallelism from the start.
+
+The reference has no attention model (SURVEY.md §5: long-context ABSENT);
+this is the framework's long-context workhorse. TPU-first choices:
+
+- the module computes on a *local sequence shard*: every position-dependent
+  op (positional embedding, causal mask) takes a ``position_offset``, so the
+  same module runs unsharded (offset 0) or under ``shard_map`` with the
+  sequence split over the ``seq`` mesh axis — where ``attention="ring"``
+  makes each block attend globally via ``parallel.sequence.ring_attention``;
+- pre-LN blocks, GELU MLP, learned positional embeddings; LayerNorm/softmax
+  statistics in fp32, matmuls in the configured compute dtype (bf16 on MXU);
+- ``attention="blockwise"`` gives O(L·block) memory single-device attention
+  (``ops.attention.blockwise_attention``) for long context without a mesh;
+- no data-dependent Python control flow: one XLA program per shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.ops.attention import (
+    blockwise_attention,
+    dense_attention,
+)
+from pytorch_distributed_tpu.parallel.mesh import SEQ_AXIS
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attention: str = "dense"  # dense | blockwise | ring
+    block_size: int = 512  # kv block for blockwise attention
+    seq_axis: str = SEQ_AXIS  # mesh axis for attention="ring"
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, position_offset):
+        cfg = self.config
+        b, l, e = x.shape
+        head_dim = e // cfg.num_heads
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H, D]
+
+        if cfg.attention == "ring":
+            from pytorch_distributed_tpu.parallel.sequence import ring_attention
+
+            # The kernel derives each shard's position as base + index*L;
+            # recover the document base from the caller's absolute offset so
+            # any position_offset convention stays consistent with the mask.
+            base = position_offset - jax.lax.axis_index(cfg.seq_axis) * l
+            out = ring_attention(
+                q, k, v, axis=cfg.seq_axis, causal=True, base_offset=base
+            )
+        elif cfg.attention == "blockwise":
+            out = blockwise_attention(
+                q, k, v, causal=True, block_size=min(cfg.block_size, l),
+                q_offset=position_offset, k_offset=position_offset,
+            )
+        elif cfg.attention == "dense":
+            out = dense_attention(
+                q, k, v, causal=True,
+                q_offset=position_offset, k_offset=position_offset,
+            )
+        else:
+            raise ValueError(f"unknown attention {self.config.attention!r}")
+        return nn.DenseGeneral(e, axis=(-2, -1), dtype=cfg.dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, position_offset):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + Attention(cfg, name="attn")(h, position_offset)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(cfg.embed_dim * cfg.mlp_ratio, dtype=cfg.dtype, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.embed_dim, dtype=cfg.dtype, name="mlp_down")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM over a (possibly sharded) token sequence.
+
+    ``__call__(tokens [B, L_local], position_offset)`` → logits
+    ``[B, L_local, vocab]`` (fp32). With attention="ring" this must run
+    under shard_map on a mesh whose ``seq`` axis shards the length.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, position_offset: jax.Array | int = 0, train: bool = True):
+        cfg = self.config
+        del train  # dropout-free for now; signature parity with ResNet
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype, name="wte")(tokens)
+        pos = position_offset + jnp.arange(tokens.shape[1])
+        x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype, name="wpe")(pos)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block{i}")(x, position_offset)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    """Small config for tests/CI."""
+    defaults = dict(
+        vocab_size=128, num_layers=2, num_heads=2, embed_dim=32,
+        max_seq_len=256, dtype=jnp.float32,
+    )
+    defaults.update(overrides)
+    return TransformerConfig(**defaults)

@@ -1,3 +1,8 @@
+from pytorch_distributed_tpu.ops.attention import (
+    attend_block,
+    blockwise_attention,
+    dense_attention,
+)
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.metrics import topk_correct, ClassificationMetrics
 from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay, build_optimizer
@@ -9,6 +14,9 @@ from pytorch_distributed_tpu.ops.precision import (
 from pytorch_distributed_tpu.ops.schedules import step_lr, warmup_cosine
 
 __all__ = [
+    "attend_block",
+    "blockwise_attention",
+    "dense_attention",
     "cross_entropy_loss",
     "topk_correct",
     "ClassificationMetrics",

@@ -1,0 +1,193 @@
+"""Attention kernels: dense reference, blockwise (memory-efficient), and the
+shared online-softmax combine that ring attention reuses.
+
+The reference has no attention at all (a ResNet CNN,
+``resnet_single_gpu.py:83``; SURVEY.md §5 "long-context: ABSENT") — this
+module is part of the framework's first-class long-context support, built
+TPU-first:
+
+- all softmax statistics in fp32 regardless of compute dtype (bf16 QK^T
+  products are fine; exp/sum are not);
+- blockwise attention is a ``lax.scan`` over key/value blocks with an
+  online-softmax accumulator (the Rabe-Staats / FlashAttention recurrence):
+  O(L·block) activation memory instead of O(L²), static shapes, MXU-sized
+  blocks; XLA autodiff differentiates the scan, and ``jax.checkpoint`` on
+  the block body keeps backward memory flat;
+- every kernel takes absolute position offsets for Q and KV, so the same
+  code computes a causal mask inside one device's shard or across ring
+  steps where the KV block came from another device
+  (``parallel/sequence.py``).
+
+Shapes follow the JAX convention: ``[batch, length, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # additive mask value; avoids -inf - -inf = nan in softmax
+
+
+class SoftmaxState(NamedTuple):
+    """Online-softmax accumulator carried across KV blocks (fp32).
+
+    o: un-normalized weighted values  [B, Lq, H, D]
+    m: running row max of logits      [B, Lq, H]
+    l: running sum of exp(logit - m)  [B, Lq, H]
+    """
+
+    o: jax.Array
+    m: jax.Array
+    l: jax.Array
+
+    @classmethod
+    def zero(cls, batch, q_len, heads, head_dim) -> "SoftmaxState":
+        return cls(
+            o=jnp.zeros((batch, q_len, heads, head_dim), jnp.float32),
+            m=jnp.full((batch, q_len, heads), NEG_INF, jnp.float32),
+            l=jnp.zeros((batch, q_len, heads), jnp.float32),
+        )
+
+    def finalize(self, dtype) -> jax.Array:
+        """Normalize. Rows that saw only masked keys produce zeros."""
+        denom = jnp.maximum(self.l, 1e-37)[..., None]
+        return (self.o / denom).astype(dtype)
+
+
+def attend_block(
+    state: SoftmaxState,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+) -> SoftmaxState:
+    """Fold one KV block into the online-softmax state.
+
+    This is the single source of truth for the attention recurrence — the
+    blockwise kernel scans it over local KV blocks and ring attention folds
+    it once per ring step with the visiting KV shard.
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    # [B, H, Lq, Lk] logits in fp32
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    allowed = None
+    if causal:
+        q_pos = q_offset + jnp.arange(lq)
+        k_pos = k_offset + jnp.arange(lk)
+        allowed = k_pos[None, :] <= q_pos[:, None]  # [Lq, Lk]
+        logits = jnp.where(allowed[None, None], logits, NEG_INF)
+
+    m_block = jnp.max(logits, axis=-1)  # [B, H, Lq]
+    m_block = jnp.transpose(m_block, (0, 2, 1))  # [B, Lq, H]
+    m_new = jnp.maximum(state.m, m_block)
+    # Avoid exp overflow for fully-masked rows: m_new >= NEG_INF.
+    correction = jnp.exp(state.m - m_new)  # [B, Lq, H]
+    p = jnp.exp(
+        logits - jnp.transpose(m_new, (0, 2, 1))[..., None]
+    )  # [B, H, Lq, Lk] fp32
+    if allowed is not None:
+        # Multiplicative zeroing so a FULLY-masked row contributes nothing
+        # (additive NEG_INF alone would leave p = exp(0) = 1 uniform there):
+        # l stays 0 and finalize() returns zeros, as documented.
+        p = p * allowed[None, None]
+    l_block = jnp.transpose(jnp.sum(p, axis=-1), (0, 2, 1))
+    o_block = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return SoftmaxState(
+        o=state.o * correction[..., None] + o_block,
+        m=m_new,
+        l=state.l * correction + l_block,
+    )
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Reference O(L²) attention (correctness baseline and short-seq path)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    probs_mask = None
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        probs_mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+        logits = jnp.where(probs_mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if probs_mask is not None:
+        # Fully-masked rows: zeros, not uniform (matches blockwise/ring).
+        probs = probs * probs_mask
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_size: int = 512,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+    remat: bool = True,
+) -> jax.Array:
+    """Memory-efficient attention: scan KV blocks with online softmax.
+
+    O(Lq·block_size) live memory; with ``remat`` the scan body is
+    rematerialized in backward, so training memory stays flat in sequence
+    length. Block size should be MXU-friendly (multiple of 128 on TPU; it
+    is clamped to the sequence length for small inputs).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bs = min(block_size, lk)
+    if lk % bs:
+        raise ValueError(f"kv length {lk} not divisible by block_size {bs}")
+    n_blocks = lk // bs
+
+    k_blocks = k.reshape(b, n_blocks, bs, h, d)
+    v_blocks = v.reshape(b, n_blocks, bs, h, d)
+
+    def body(state, inputs):
+        i, kb, vb = inputs
+        state = attend_block(
+            state, q, kb, vb,
+            scale=scale, causal=causal,
+            q_offset=q_offset, k_offset=k_offset + i * bs,
+        )
+        return state, None
+
+    if remat:
+        body = jax.checkpoint(body)
+
+    init = SoftmaxState.zero(b, lq, h, d)
+    idx = jnp.arange(n_blocks)
+    state, _ = jax.lax.scan(
+        body, init, (idx, jnp.moveaxis(k_blocks, 1, 0), jnp.moveaxis(v_blocks, 1, 0))
+    )
+    return state.finalize(q.dtype)

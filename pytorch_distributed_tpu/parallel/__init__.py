@@ -1,6 +1,7 @@
 from pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    SEQ_AXIS,
     batch_sharding,
     global_batch_size,
     local_mesh,
@@ -17,6 +18,10 @@ from pytorch_distributed_tpu.parallel.distributed import (
     init_process_group,
     is_primary,
 )
+from pytorch_distributed_tpu.parallel.sequence import (
+    ring_attention,
+    ring_attention_sharded,
+)
 from pytorch_distributed_tpu.parallel.collectives import (
     all_reduce,
     broadcast_from_primary,
@@ -27,6 +32,7 @@ from pytorch_distributed_tpu.parallel.collectives import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "SEQ_AXIS",
     "make_mesh",
     "single_device_mesh",
     "local_mesh",
@@ -40,6 +46,8 @@ __all__ = [
     "get_world_size",
     "is_primary",
     "barrier",
+    "ring_attention",
+    "ring_attention_sharded",
     "all_reduce",
     "broadcast_from_primary",
     "psum_tree",

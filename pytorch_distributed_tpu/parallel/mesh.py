@@ -8,10 +8,11 @@ stack (D6/D7/D13: ``dist.init_process_group('nccl', ...)``,
 compiles the gradient all-reduce into the step program and routes it over
 ICI (intra-pod) / DCN (cross-pod) automatically.
 
-The mesh always carries a ``data`` axis (the only one the reference's
-capability surface uses — all three DP flavors map onto it) and optionally a
-``model`` axis, left addable per SURVEY.md §2c so tensor parallelism is a
-sharding-spec change, not a redesign.
+The mesh always carries three axes — ``data`` (the only one the reference's
+capability surface uses: all three DP flavors map onto it), ``seq``
+(sequence/context parallelism, ``parallel.sequence``), and ``model``
+(tensor parallelism) — so adding a parallelism dimension is a sharding-spec
+change, not a redesign (SURVEY.md §2c).
 """
 
 from __future__ import annotations
@@ -22,7 +23,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.8: top-level export; older: experimental module
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 
@@ -30,29 +37,45 @@ def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     data_parallel: Optional[int] = None,
     model_parallel: int = 1,
-    axis_names: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
+    seq_parallel: int = 1,
+    axis_names: Sequence[str] = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS),
 ) -> Mesh:
-    """Build a (data, model) mesh over the given (default: all) devices.
+    """Build a (data, seq, model) mesh over the given (default: all) devices.
 
-    With ``model_parallel=1`` (the reference's entire capability surface)
-    this is a pure data-parallel mesh: one replica per chip, the exact
-    topology ``DistributedDataParallel`` builds with one process per GPU
-    (``restnet_ddp.py:154-155``) — minus the processes: a single program
+    With ``model_parallel=seq_parallel=1`` (the reference's entire capability
+    surface) this is a pure data-parallel mesh: one replica per chip, the
+    exact topology ``DistributedDataParallel`` builds with one process per
+    GPU (``restnet_ddp.py:154-155``) — minus the processes: a single program
     spans every chip on every host.
+
+    The ``seq`` axis carries sequence/context parallelism (ring attention,
+    ``parallel.sequence``) and the ``model`` axis tensor parallelism — both
+    absent from the reference (SURVEY.md §2c) but first-class here. Axis
+    order is (data, seq, model) so the innermost (fastest-varying, i.e.
+    physically closest over ICI) devices carry the most latency-sensitive
+    collectives.
     """
+    if len(axis_names) != 3:
+        raise ValueError(
+            f"make_mesh builds a 3-axis (data, seq, model) grid; got "
+            f"axis_names={tuple(axis_names)}"
+        )
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
     n = len(devices)
+    inner = model_parallel * seq_parallel
     if data_parallel is None:
-        if n % model_parallel:
-            raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
-        data_parallel = n // model_parallel
-    if data_parallel * model_parallel != n:
+        if n % inner:
+            raise ValueError(
+                f"{n} devices not divisible by seq_parallel*model_parallel={inner}"
+            )
+        data_parallel = n // inner
+    if data_parallel * inner != n:
         raise ValueError(
-            f"mesh {data_parallel}x{model_parallel} != {n} devices"
+            f"mesh {data_parallel}x{seq_parallel}x{model_parallel} != {n} devices"
         )
-    grid = np.asarray(devices).reshape(data_parallel, model_parallel)
+    grid = np.asarray(devices).reshape(data_parallel, seq_parallel, model_parallel)
     return Mesh(grid, axis_names=tuple(axis_names))
 
 

@@ -1,0 +1,116 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+First-class long-context support (absent from the reference — SURVEY.md §5
+"long-context: ABSENT" — but required of this framework): sequences are
+sharded over the ``seq`` axis, each device holds Q/K/V for its L/S-token
+shard, and K/V shards travel around the ring with ``lax.ppermute`` over ICI
+while every device folds the visiting block into an online-softmax
+accumulator (``ops.attention.attend_block`` — the same recurrence the
+blockwise kernel scans locally). After S steps every query has attended to
+every key, with O(L/S) memory per device and L² compute spread S ways.
+
+TPU-first details:
+- the next-step ``ppermute`` is independent of the current fold, so XLA's
+  latency-hiding scheduler overlaps the ICI transfer with the block matmuls
+  (the hand-written overlap the GPU ring-attention papers implement with
+  separate comm streams);
+- causal masking uses absolute position offsets derived from the ring step,
+  so the math is identical to single-device causal attention (verified in
+  tests/test_sequence.py);
+- everything lives inside ``shard_map`` and differentiates through scan +
+  ppermute, so the same code trains.
+
+The plain ring schedule wastes work for causal masks (fully-masked blocks
+are still computed, ~2x); a zigzag/striped schedule removes that and is a
+planned optimization, noted here so the cost model is honest.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.attention import SoftmaxState, attend_block
+from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, shard_map
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    base_offset: jax.Array | int = 0,
+    remat: bool = True,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis`` (call under shard_map).
+
+    Args:
+      q, k, v: this device's shards, ``[B, L_local, H, D]``; global length
+        is ``L_local * axis_size``, shard i holding tokens
+        ``[base_offset + i*L_local, base_offset + (i+1)*L_local)``.
+      causal: apply the global causal mask (offsets handled per ring step).
+      base_offset: absolute position of the sharded sequence's first token
+        (non-zero when attending over a chunk of a longer document).
+
+    Returns: ``[B, L_local, H, D]`` — this device's rows of the exact
+    softmax(QK^T)V over the full sequence (bit-comparable to dense
+    attention on the gathered sequence, up to fp accumulation order).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    q_offset = base_offset + my * lq
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def body(carry, step):
+        state, (k_cur, v_cur) = carry
+        # kv shard currently held originated on device (my - step) mod s
+        src = jax.lax.rem(my - step + s, s)
+        # Rotate for the next step first: independent of the fold below, so
+        # the ICI transfer overlaps the matmuls.
+        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis, perm)
+        state = attend_block(
+            state, q, k_cur, v_cur,
+            scale=scale, causal=causal,
+            q_offset=q_offset, k_offset=base_offset + src * lk,
+        )
+        return (state, (k_nxt, v_nxt)), None
+
+    if remat:
+        body = jax.checkpoint(body)
+
+    init = (SoftmaxState.zero(b, lq, h, d), (k, v))
+    (state, _), _ = jax.lax.scan(body, init, jnp.arange(s))
+    return state.finalize(q.dtype)
+
+
+def ring_attention_sharded(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Convenience wrapper: global ``[B, L, H, D]`` arrays, batch sharded on
+    ``data`` and length on ``seq``; returns the globally-sharded output.
+    Inside a larger shard_map'd step, call ``ring_attention`` directly."""
+    spec = P(DATA_AXIS, SEQ_AXIS)
+    fn = shard_map(
+        partial(ring_attention, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,11 +1,19 @@
 from pytorch_distributed_tpu.train.state import TrainState
 from pytorch_distributed_tpu.train.step import make_eval_step, make_train_step
+from pytorch_distributed_tpu.train.lm import (
+    create_lm_state,
+    make_lm_train_step,
+    shift_labels,
+)
 from pytorch_distributed_tpu.train.trainer import Trainer, TrainerConfig
 
 __all__ = [
     "TrainState",
     "make_train_step",
     "make_eval_step",
+    "create_lm_state",
+    "make_lm_train_step",
+    "shift_labels",
     "Trainer",
     "TrainerConfig",
 ]

@@ -1,0 +1,135 @@
+"""Compiled LM training step over a (data, seq) mesh.
+
+The image trainer's step (``train/step.py``) parallelizes over ``data``
+only; language-model training adds the ``seq`` axis: the token sequence is
+split across devices, attention goes global through the ring
+(``parallel.sequence``), and gradients are combined over BOTH axes — every
+device holds a full replica of the parameters, sharded activations only.
+This is the long-context training configuration the reference cannot
+express (SURVEY.md §2c: SP/CP absent).
+
+Layout:
+  tokens/labels  [B, L] → P(data, seq)    (labels are next-token targets,
+                                           shifted on the host so the
+                                           shard-boundary token's target
+                                           lives with its logits)
+  params/opt     replicated               (pure DP+SP; TP is the mesh's
+                                           third axis, unused here)
+  grad combine   psum over (data, seq) of each device's share of the
+                 global-mean loss gradient
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, shard_map
+from pytorch_distributed_tpu.train.state import TrainState
+
+
+def shift_labels(tokens, pad_id: int = 0):
+    """Host-side next-token targets: labels[t] = tokens[t+1]; the final
+    position predicts ``pad_id`` and is masked by ``weights``."""
+    import numpy as np
+
+    labels = np.concatenate(
+        [tokens[:, 1:], np.full((tokens.shape[0], 1), pad_id, tokens.dtype)], axis=1
+    )
+    weights = np.ones_like(tokens, np.float32)
+    weights[:, -1] = 0.0
+    return labels, weights
+
+
+def create_lm_state(
+    config,
+    tx,
+    rng: jax.Array,
+    init_len: Optional[int] = None,
+) -> TrainState:
+    """TrainState for a TransformerLM.
+
+    Parameters are initialized through a dense-attention twin of the config
+    (identical parameter tree; ring attention needs a mesh axis context that
+    does not exist at init time), then the state's ``apply_fn`` is the real
+    configured model.
+    """
+    import dataclasses
+
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+    dense_cfg = dataclasses.replace(config, attention="dense")
+    init_model = TransformerLM(dense_cfg)
+    state = TrainState.create(
+        init_model,
+        tx,
+        rng,
+        (1, init_len or min(config.max_seq_len, 128)),
+        input_dtype=jnp.int32,
+    )
+    return state.replace(apply_fn=TransformerLM(config).apply)
+
+
+def make_lm_train_step(
+    mesh: Mesh,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQ_AXIS,
+) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
+    """Build ``step(state, batch) -> (state, metrics)``.
+
+    ``batch``: {"tokens": [B, L] i32, "labels": [B, L] i32,
+    "weights": [B, L] f32} as global arrays sharded P(data, seq).
+    """
+    axes = (data_axis, seq_axis)
+
+    def _local_step(state: TrainState, batch: dict):
+        lq = batch["tokens"].shape[1]
+        offset = jax.lax.axis_index(seq_axis) * lq
+        # Token count is param-independent, so its psum can live outside the
+        # differentiated function. No param-dependent psum may sit inside
+        # loss_fn: under shard_map a psum transposes to another psum, which
+        # would scale the gradient by the axis size.
+        global_count = jax.lax.psum(jnp.sum(batch["weights"]), axes)
+
+        def loss_fn(params):
+            logits = state.apply_fn(
+                {"params": params}, batch["tokens"], position_offset=offset
+            )
+            per_tok = cross_entropy_loss(
+                logits.reshape(-1, logits.shape[-1]),
+                batch["labels"].reshape(-1),
+                reduction="none",
+            )
+            w = batch["weights"].reshape(-1)
+            # This device's share of the global mean loss.
+            return jnp.sum(per_tok * w) / jnp.maximum(global_count, 1.0)
+
+        # local_loss_i = s_i / C  ⇒  psum(grad local_loss_i) = grad of the
+        # global mean loss w.r.t. the replicated params.
+        local_loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        loss = jax.lax.psum(local_loss, axes)
+        grads = jax.lax.psum(grads, axes)
+        count = global_count
+
+        updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(jnp.add, state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+        )
+        metrics = {"loss": loss, "tokens": count}
+        return new_state, metrics
+
+    sharded = shard_map(
+        _local_step,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

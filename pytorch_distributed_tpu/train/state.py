@@ -48,10 +48,12 @@ class TrainState:
         rng: jax.Array,
         input_shape,
         scaler: Optional[Any] = None,
+        input_dtype=jnp.float32,
     ) -> "TrainState":
         """Initialize from a flax module (≙ constructing model+optimizer,
-        ``restnet_ddp.py:98,122``)."""
-        variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
+        ``restnet_ddp.py:98,122``). ``input_dtype=jnp.int32`` for token
+        models."""
+        variables = model.init(rng, jnp.zeros(input_shape, input_dtype), train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         return cls(

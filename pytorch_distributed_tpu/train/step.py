@@ -35,15 +35,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.metrics import ClassificationMetrics
 from pytorch_distributed_tpu.ops.precision import NoOpLossScaler, all_finite
-from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS
+from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, shard_map
 from pytorch_distributed_tpu.train.state import TrainState
 
 

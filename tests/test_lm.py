@@ -1,0 +1,82 @@
+"""LM training over (data, seq) meshes: ring-parallel step == single-device
+dense step, and learning works on a toy task."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models.transformer import TransformerLM, tiny_config
+from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
+from pytorch_distributed_tpu.parallel import make_mesh, replicated_sharding
+from pytorch_distributed_tpu.train.lm import (
+    create_lm_state,
+    make_lm_train_step,
+    shift_labels,
+)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def batch_for(mesh, b=4, l=32, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, 128, (b, l)).astype(np.int32)
+    labels, weights = shift_labels(tokens)
+    sharding = NamedSharding(mesh, P("data", "seq"))
+    put = lambda x: jax.device_put(x, sharding)
+    return {"tokens": put(tokens), "labels": put(labels), "weights": put(weights)}
+
+
+def run_steps(mesh, attention, steps=3, lr=0.1):
+    cfg = tiny_config(attention=attention)
+    tx = sgd_with_weight_decay(lr, momentum=0.9, weight_decay=0.0)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step_fn = make_lm_train_step(mesh)
+    losses = []
+    for i in range(steps):
+        state, metrics = step_fn(state, batch_for(mesh, seed=i))
+        losses.append(float(metrics["loss"]))
+    return state, losses
+
+
+@pytest.mark.parametrize("dp,sp", [(2, 4), (1, 8)])
+def test_ring_lm_matches_single_device_dense(devices8, dp, sp):
+    mesh_sp = make_mesh(devices8, data_parallel=dp, seq_parallel=sp)
+    mesh_one = make_mesh(devices8[:1])
+    state_sp, losses_sp = run_steps(mesh_sp, "ring")
+    state_one, losses_one = run_steps(mesh_one, "dense")
+    np.testing.assert_allclose(losses_sp, losses_one, rtol=2e-4)
+    for a, b in zip(
+        jax.tree.leaves(state_sp.params), jax.tree.leaves(state_one.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+        )
+
+
+def test_lm_loss_decreases(devices8):
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=4)
+    cfg = tiny_config(attention="ring")
+    tx = sgd_with_weight_decay(0.3, momentum=0.9, weight_decay=0.0)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step_fn = make_lm_train_step(mesh)
+    batch = batch_for(mesh, seed=42)  # fixed batch: memorization test
+    first = last = None
+    for i in range(12):
+        state, metrics = step_fn(state, batch)
+        loss = float(metrics["loss"])
+        first = first if first is not None else loss
+        last = loss
+    assert last < first * 0.7, (first, last)
+
+
+def test_blockwise_lm_forward_matches_dense():
+    cfg_d = tiny_config(attention="dense")
+    cfg_b = tiny_config(attention="blockwise", block_size=8)
+    model_d, model_b = TransformerLM(cfg_d), TransformerLM(cfg_b)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(1, 128, (2, 32)), jnp.int32)
+    variables = model_d.init(jax.random.key(0), tokens)
+    out_d = model_d.apply(variables, tokens)
+    out_b = model_b.apply(variables, tokens)
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_d), rtol=2e-4, atol=2e-5)
