@@ -44,6 +44,34 @@ class TransformerConfig:
     attention: str = "dense"  # dense | blockwise | ring
     block_size: int = 512  # kv block for blockwise attention
     seq_axis: str = SEQ_AXIS  # mesh axis for attention="ring"
+    # Megatron-style tensor parallelism: set model_axis to the mesh's model
+    # axis name and tp_size to its size when running under shard_map with
+    # params sharded by ``train.lm.TRANSFORMER_TP_RULES``. Parameters keep
+    # GLOBAL shapes in the state (sharding is placement; checkpoints are
+    # interchangeable across tp degrees); tp_size tells the module the LOCAL
+    # feature widths flax should expect at apply time. None/1 = no TP.
+    model_axis: Optional[str] = None
+    tp_size: int = 1
+
+    def __post_init__(self):
+        if self.embed_dim % self.num_heads:
+            raise ValueError(
+                f"embed_dim {self.embed_dim} not divisible by num_heads {self.num_heads}"
+            )
+        if self.num_heads % self.tp_size:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by tp_size {self.tp_size}"
+            )
+        if (self.embed_dim * self.mlp_ratio) % self.tp_size:
+            raise ValueError(
+                f"mlp width {self.embed_dim * self.mlp_ratio} not divisible "
+                f"by tp_size {self.tp_size}"
+            )
+        if self.dropout:
+            raise NotImplementedError(
+                "dropout is not implemented yet; set dropout=0.0 (a silently "
+                "ignored regularization knob would be worse than an error)"
+            )
 
 
 class Attention(nn.Module):
@@ -54,10 +82,15 @@ class Attention(nn.Module):
         cfg = self.config
         b, l, e = x.shape
         head_dim = e // cfg.num_heads
+        if cfg.model_axis:
+            from pytorch_distributed_tpu.parallel.tensor import tp_copy
+
+            x = tp_copy(x, cfg.model_axis)  # column-parallel qkv below
+        heads_local = cfg.num_heads // cfg.tp_size
         qkv = nn.DenseGeneral(
-            (3, cfg.num_heads, head_dim), dtype=cfg.dtype, name="qkv"
+            (3, heads_local, head_dim), dtype=cfg.dtype, name="qkv"
         )(x)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H, D]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H_loc, D]
 
         if cfg.attention == "ring":
             from pytorch_distributed_tpu.parallel.sequence import ring_attention
@@ -81,7 +114,16 @@ class Attention(nn.Module):
             )
         else:
             raise ValueError(f"unknown attention {self.config.attention!r}")
-        return nn.DenseGeneral(e, axis=(-2, -1), dtype=cfg.dtype, name="proj")(out)
+        # Row-parallel output projection: bias-free so the TP psum does not
+        # add the bias tp times.
+        out = nn.DenseGeneral(
+            e, axis=(-2, -1), use_bias=False, dtype=cfg.dtype, name="proj"
+        )(out)
+        if cfg.model_axis:
+            from pytorch_distributed_tpu.parallel.tensor import tp_reduce
+
+            out = tp_reduce(out, cfg.model_axis)
+        return out
 
 
 class Block(nn.Module):
@@ -93,9 +135,19 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + Attention(cfg, name="attn")(h, position_offset)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        h = nn.Dense(cfg.embed_dim * cfg.mlp_ratio, dtype=cfg.dtype, name="mlp_up")(h)
+        if cfg.model_axis:
+            from pytorch_distributed_tpu.parallel.tensor import tp_copy, tp_reduce
+
+            h = tp_copy(h, cfg.model_axis)  # column-parallel mlp_up
+        h = nn.Dense(
+            cfg.embed_dim * cfg.mlp_ratio // cfg.tp_size, dtype=cfg.dtype,
+            name="mlp_up",
+        )(h)
         h = nn.gelu(h)
-        h = nn.Dense(cfg.embed_dim, dtype=cfg.dtype, name="mlp_down")(h)
+        # Row-parallel mlp_down: bias-free (see Attention.proj).
+        h = nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype, name="mlp_down")(h)
+        if cfg.model_axis:
+            h = tp_reduce(h, cfg.model_axis)
         return x + h
 
 

@@ -71,25 +71,36 @@ def ring_attention(
     q_offset = base_offset + my * lq
     perm = [(i, (i + 1) % s) for i in range(s)]
 
-    def body(carry, step):
-        state, (k_cur, v_cur) = carry
+    def fold(state, k_cur, v_cur, step):
         # kv shard currently held originated on device (my - step) mod s
         src = jax.lax.rem(my - step + s, s)
-        # Rotate for the next step first: independent of the fold below, so
-        # the ICI transfer overlaps the matmuls.
-        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis, perm)
-        state = attend_block(
+        return attend_block(
             state, q, k_cur, v_cur,
             scale=scale, causal=causal,
             q_offset=q_offset, k_offset=base_offset + src * lk,
         )
+
+    def body(carry, step):
+        state, (k_cur, v_cur) = carry
+        # Rotate for the next step first: independent of the fold below, so
+        # the ICI transfer overlaps the matmuls.
+        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis, perm)
+        state = fold(state, k_cur, v_cur, step)
         return (state, (k_nxt, v_nxt)), None
 
     if remat:
         body = jax.checkpoint(body)
+        fold = jax.checkpoint(fold)
 
     init = (SoftmaxState.zero(b, lq, h, d), (k, v))
-    (state, _), _ = jax.lax.scan(body, init, jnp.arange(s))
+    # s-1 rotate+fold steps, then fold the last visiting shard with no
+    # rotation — a full-s scan would ship K/V around the ring once more
+    # only to discard them.
+    if s > 1:
+        (state, (k_last, v_last)), _ = jax.lax.scan(body, init, jnp.arange(s - 1))
+    else:
+        state, (k_last, v_last) = init
+    state = fold(state, k_last, v_last, s - 1)
     return state.finalize(q.dtype)
 
 

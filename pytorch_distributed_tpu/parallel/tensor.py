@@ -1,0 +1,136 @@
+"""Tensor parallelism primitives: the f/g collective pair and partition rules.
+
+Megatron-style TP inside ``shard_map``: weights of "column-parallel" layers
+are split on their output dimension (each device computes a slice of the
+features), "row-parallel" layers on their input dimension (each device
+computes a partial sum that one ``psum`` completes). Two custom-vjp
+identities make autodiff correct by construction, independent of shard_map's
+replication checking:
+
+- ``tp_copy`` ("f"): forward identity on a replicated activation entering a
+  column-parallel layer; backward psums the partial cotangents over the
+  model axis, so everything upstream (embeddings, layernorms) receives full
+  gradients and replicated params need no extra grad collective.
+- ``tp_reduce`` ("g"): forward psum completing a row-parallel layer;
+  backward identity (the cotangent is already replicated — a plain psum's
+  transpose would multiply it by the axis size).
+
+Row-parallel layers must not add a bias before ``tp_reduce`` (it would be
+summed tp times); the transformer keeps those projections bias-free.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.parallel.mesh import MODEL_AXIS
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_copy(x, axis_name: str):
+    return x
+
+
+def _tp_copy_fwd(x, axis_name):
+    return x, None
+
+
+def _tp_copy_bwd(axis_name, _res, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+_tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+def tp_copy(x, axis_name: str = MODEL_AXIS):
+    """Identity forward, psum backward (enter a column-parallel region)."""
+    return _tp_copy(x, axis_name)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_reduce(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def _tp_reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _tp_reduce_bwd(axis_name, _res, g):
+    return (g,)
+
+
+_tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+def tp_reduce(x, axis_name: str = MODEL_AXIS):
+    """Psum forward, identity backward (exit a row-parallel region)."""
+    return _tp_reduce(x, axis_name)
+
+
+# ---- partition rules (the standard path-regex → PartitionSpec mapping) ----
+
+
+def path_str(path) -> str:
+    """'block0/attn/qkv/kernel'-style string for a jax tree path."""
+    parts = []
+    for p in path:
+        name = getattr(p, "key", None)
+        if name is None:
+            name = getattr(p, "name", None)
+        if name is None:
+            name = str(getattr(p, "idx", p))
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def match_partition_rules(
+    rules: Sequence[Tuple[str, P]], tree: Any, default: P = P()
+) -> Any:
+    """PartitionSpec pytree for ``tree``: first regex (re.search) that matches
+    each leaf's path wins; scalars and unmatched leaves get ``default``."""
+
+    def assign(path, leaf):
+        name = path_str(path)
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        return default
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+def opt_state_specs(params: Any, param_specs: Any, tx) -> Any:
+    """PartitionSpec tree for ``tx.init(params)``'s state.
+
+    Optimizer state (momentum traces, second moments, …) embeds copies of
+    the parameter tree; each such leaf must shard exactly like its
+    parameter. Leaves are matched by their tree-path suffix (optax state
+    paths end with the full parameter path); anything else (schedule counts,
+    scalars) is replicated.
+    """
+    flat_param_specs = {
+        path_str(path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(param_specs)[0]
+    }
+    opt_shapes = jax.eval_shape(tx.init, params)
+
+    def assign(path, leaf):
+        name = path_str(path)
+        for param_path, spec in flat_param_specs.items():
+            if name.endswith(param_path):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(assign, opt_shapes)

@@ -28,7 +28,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, shard_map
+from pytorch_distributed_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    shard_map,
+)
 from pytorch_distributed_tpu.train.state import TrainState
 
 
@@ -62,7 +67,12 @@ def create_lm_state(
 
     from pytorch_distributed_tpu.models.transformer import TransformerLM
 
-    dense_cfg = dataclasses.replace(config, attention="dense")
+    # Init twin: dense attention (ring needs a mesh axis context that does
+    # not exist at init) and no TP collectives. Parameter shapes are global
+    # either way, so the produced tree serves every parallel layout.
+    dense_cfg = dataclasses.replace(
+        config, attention="dense", model_axis=None, tp_size=1
+    )
     init_model = TransformerLM(dense_cfg)
     state = TrainState.create(
         init_model,
@@ -74,15 +84,68 @@ def create_lm_state(
     return state.replace(apply_fn=TransformerLM(config).apply)
 
 
+# Megatron-style placement for TransformerLM parameters (paths from the flax
+# module tree). Column-parallel layers shard their output dim, row-parallel
+# their input dim; embeddings, layernorms, and lm_head stay replicated.
+TRANSFORMER_TP_RULES = (
+    (r"attn/qkv/kernel", P(None, None, MODEL_AXIS, None)),  # [E,3,H,D] → H
+    (r"attn/qkv/bias", P(None, MODEL_AXIS, None)),  # [3,H,D] → H
+    (r"attn/proj/kernel", P(MODEL_AXIS, None, None)),  # [H,D,E] → H
+    (r"mlp_up/kernel", P(None, MODEL_AXIS)),  # [E,4E] → 4E
+    (r"mlp_up/bias", P(MODEL_AXIS,)),  # [4E]
+    (r"mlp_down/kernel", P(MODEL_AXIS, None)),  # [4E,E] → 4E
+)
+
+
+def lm_state_specs(state: TrainState, rules=TRANSFORMER_TP_RULES) -> TrainState:
+    """PartitionSpec pytree shaped like ``state``: params by the TP rules,
+    optimizer state following its embedded parameter copies, everything
+    else replicated."""
+    from pytorch_distributed_tpu.parallel.tensor import (
+        match_partition_rules,
+        opt_state_specs,
+    )
+
+    param_specs = match_partition_rules(rules, state.params)
+    return state.replace(
+        step=P(),
+        params=param_specs,
+        batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
+        opt_state=opt_state_specs(state.params, param_specs, state.tx),
+        scaler=jax.tree.map(lambda _: P(), state.scaler),
+    )
+
+
+def shard_lm_state(mesh: Mesh, state: TrainState) -> Tuple[TrainState, TrainState]:
+    """Place a (host or replicated) state onto the mesh per the TP rules.
+
+    Returns (placed_state, spec_state). For tp=1 meshes the specs shard
+    nothing (every spec axis has size 1) and this is plain replication.
+    """
+    specs = lm_state_specs(state)
+    shardings = jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(state, shardings), specs
+
+
 def make_lm_train_step(
     mesh: Mesh,
     data_axis: str = DATA_AXIS,
     seq_axis: str = SEQ_AXIS,
+    state_specs: Optional[TrainState] = None,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build ``step(state, batch) -> (state, metrics)``.
 
     ``batch``: {"tokens": [B, L] i32, "labels": [B, L] i32,
     "weights": [B, L] f32} as global arrays sharded P(data, seq).
+    ``state_specs``: TrainState-shaped PartitionSpec tree (from
+    ``lm_state_specs``) when parameters are tensor-parallel; default fully
+    replicated. Gradients are psum'd over (data, seq) only — the model-axis
+    collectives live inside the model via tp_copy/tp_reduce, which leave
+    sharded-param grads local and replicated-param grads already complete.
     """
     axes = (data_axis, seq_axis)
 
@@ -125,11 +188,12 @@ def make_lm_train_step(
         metrics = {"loss": loss, "tokens": count}
         return new_state, metrics
 
+    state_spec = state_specs if state_specs is not None else P()
     sharded = shard_map(
         _local_step,
         mesh=mesh,
-        in_specs=(P(), P(data_axis, seq_axis)),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, P(data_axis, seq_axis)),
+        out_specs=(state_spec, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
