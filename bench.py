@@ -71,7 +71,9 @@ def build(batch_size: int, tiny: bool):
     return state, step, batch
 
 
-def run(batch_size: int, tiny: bool, warmup: int = 10, iters: int = 30) -> float:
+def run(batch_size: int, tiny: bool, warmup: int = 10, iters: int = 30):
+    from pytorch_distributed_tpu.utils.profiling import device_duty_cycle
+
     state, step, batch = build(batch_size, tiny)
     for _ in range(warmup):
         state, metrics = step(state, batch)
@@ -88,7 +90,8 @@ def run(batch_size: int, tiny: bool, warmup: int = 10, iters: int = 30) -> float
     dt = time.perf_counter() - t0
     if not np.isfinite(loss):
         raise RuntimeError(f"non-finite loss {loss}")
-    return batch_size * iters / dt
+    duty = device_duty_cycle(step, state, batch, iters=10)
+    return batch_size * iters / dt, duty
 
 
 def main() -> None:
@@ -99,7 +102,7 @@ def main() -> None:
         raise ValueError(f"BENCH_BS must be >= 1, got {batch_size}")
     while True:
         try:
-            img_s = run(batch_size, tiny)
+            img_s, duty = run(batch_size, tiny)
             break
         except Exception as e:  # XlaRuntimeError isn't a stable import path
             if "RESOURCE_EXHAUSTED" in str(e) and batch_size > 8:
@@ -115,6 +118,7 @@ def main() -> None:
                 "value": round(img_s, 2),
                 "unit": "img/s",
                 "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+                "duty_cycle": round(duty, 4),  # ≙ result.png "avg GPU util"
                 "batch_size": batch_size,
                 "platform": jax.devices()[0].platform,
                 "device": str(jax.devices()[0]),

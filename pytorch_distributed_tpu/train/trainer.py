@@ -24,6 +24,7 @@ best-checkpoint tracking (``restnet_ddp.py:145-150``), epoch timing log
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Optional
 
@@ -39,6 +40,7 @@ from pytorch_distributed_tpu.train.state import TrainState
 from pytorch_distributed_tpu.train.step import make_eval_step, make_train_step
 from pytorch_distributed_tpu.utils.checkpoint import Checkpointer
 from pytorch_distributed_tpu.utils.logging import rank0_print
+from pytorch_distributed_tpu.utils.profiling import MetricsLogger, trace
 from pytorch_distributed_tpu.utils.suspend import NullSuspendWatcher, SuspendWatcher
 
 
@@ -157,6 +159,14 @@ class Trainer:
         self.start_epoch = 0
         self.start_step = 0
 
+        # Observability (SURVEY.md §5: the reference has only time.time()
+        # prints; we keep those AND stream machine-readable metrics).
+        self.metrics_log = MetricsLogger(
+            os.path.join(config.save_dir, "metrics.jsonl")
+            if jax.process_index() == 0
+            else None
+        )
+
     # ---- checkpoint contract (SURVEY.md §3.5) ----
 
     def _payload(self, epoch: int, step: int) -> dict:
@@ -211,18 +221,39 @@ class Trainer:
         """One training epoch (ref ``train``, ``restnet_ddp.py:19-47``)."""
         cfg = self.config
         last = {}
+        global_bs = mesh_lib.global_batch_size(self.mesh, cfg.batch_size)
+        t0 = time.perf_counter()
+        steps_done = 0
         for step, host_batch in enumerate(
             self.train_loader.iter_batches(start_step), start=start_step
         ):
             batch = mesh_lib.shard_batch(self.mesh, host_batch)
             self.state, metrics = self.train_step(self.state, batch)
+            steps_done += 1
             if cfg.log_every and step % cfg.log_every == 0:
                 last = {k: float(v) for k, v in metrics.items()}
+                acc1 = 100.0 * last["correct1"] / max(last["count"], 1)
                 rank0_print(
                     f"epoch {epoch} step {step}: loss {last['loss']:.4f} "
-                    f"acc1 {100.0 * last['correct1'] / max(last['count'], 1):.2f}"
+                    f"acc1 {acc1:.2f}"
+                )
+                self.metrics_log.log(
+                    kind="train", epoch=epoch, step=step, loss=last["loss"],
+                    acc1=acc1,
                 )
             self._maybe_suspend(epoch, step)
+        if steps_done:
+            # Drain the async dispatch queue with a value fetch before
+            # reading the clock — per-step host timestamps would measure
+            # dispatch gaps, not device time (first epoch includes compile,
+            # same caveat as the reference's epoch timing).
+            float(self.state.step)
+            elapsed = time.perf_counter() - t0
+            self.metrics_log.log(
+                kind="epoch_timing", epoch=epoch, steps=steps_done,
+                mean_ms=1e3 * elapsed / steps_done,
+                items_per_s=global_bs * steps_done / elapsed,
+            )
         return last
 
     def validate(self) -> dict:
@@ -259,7 +290,12 @@ class Trainer:
             t0 = time.time()
             self.train_sampler.set_epoch(epoch)  # ref restnet_ddp.py:137
             start_step = self.start_step if epoch == self.start_epoch else 0
-            self.train_epoch(epoch, start_step)
+            # jax.profiler capture when PDT_TRACE_DIR is set — first epoch of
+            # this run only (tracing all epochs would buffer multi-GB of
+            # events on the host).
+            with trace(enabled=bool(os.environ.get("PDT_TRACE_DIR"))
+                       and epoch == self.start_epoch):
+                self.train_epoch(epoch, start_step)
             summary = self.validate()
             rank0_print(
                 f"epoch {epoch}: val loss {summary['loss']:.4f} "
@@ -270,9 +306,13 @@ class Trainer:
                 if jax.process_index() == 0:
                     self.ckpt.save_best(self._payload(epoch + 1, 0))
                 rank0_print(f"new best acc1 {self.best_acc:.2f}, saved best.ckpt")
+            epoch_s = time.time() - t0
             rank0_print(
-                f"epoch {epoch} cost time: {time.time() - t0:.1f} s"
+                f"epoch {epoch} cost time: {epoch_s:.1f} s"
             )  # ref restnet_ddp.py:146
+            self.metrics_log.log(
+                kind="val", epoch=epoch, epoch_s=epoch_s, **summary
+            )
         self.start_step = 0
         summary["best_acc"] = self.best_acc
         return summary

@@ -1,0 +1,152 @@
+"""Profiling and observability.
+
+The reference's entire observability story is wall-clock epoch timing via
+``time.time()`` prints (``restnet_ddp.py:136-146``; SURVEY.md §5 "tracing:
+ABSENT" — GPU util/memory in result.png were measured externally by the
+cluster). This module is the in-framework replacement:
+
+- ``trace``: ``jax.profiler`` capture behind a flag/env — one context
+  manager wraps any region (an epoch, N steps) and writes a TensorBoard-
+  loadable trace with XLA op/fusion timelines (the TPU answer to nvprof);
+- ``StepTimer``: wall-clock step/epoch statistics with warmup exclusion —
+  honest throughput numbers (first steps include compilation);
+- ``device_duty_cycle``: the TPU analog of nvidia-smi "GPU util" — the
+  fraction of wall time the device spent executing, derived by comparing
+  back-to-back synced step time against dispatch-gap-free time;
+- ``MetricsLogger``: JSONL metrics stream (step, loss, acc, lr, img/s) so
+  runs are machine-comparable, not print-scraped.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None, enabled: Optional[bool] = None) -> Iterator[None]:
+    """``jax.profiler`` trace region.
+
+    Enabled when ``enabled`` is True or env ``PDT_TRACE_DIR`` is set; traces
+    land in ``log_dir`` (default the env value). View with TensorBoard's
+    profile plugin or xprof.
+    """
+    env_dir = os.environ.get("PDT_TRACE_DIR")
+    if enabled is None:
+        enabled = env_dir is not None or log_dir is not None
+    if not enabled:
+        yield
+        return
+    import jax
+
+    target = log_dir or env_dir or "/tmp/pdt_trace"
+    os.makedirs(target, exist_ok=True)
+    with jax.profiler.trace(target):
+        yield
+
+
+class StepTimer:
+    """Wall-clock step statistics with warmup exclusion.
+
+    ``tick()`` per step; ``summary(items_per_step)`` → mean/p50/p95 step ms
+    and items/s over the post-warmup window.
+    """
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self._times: list[float] = []
+        self._last: Optional[float] = None
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    def reset(self) -> None:
+        self._times.clear()
+        self._last = None
+
+    @property
+    def steps(self) -> int:
+        return max(len(self._times) - self.warmup_steps, 0)
+
+    def summary(self, items_per_step: Optional[int] = None) -> dict:
+        times = np.asarray(self._times[self.warmup_steps:])
+        if times.size == 0:
+            return {"steps": 0}
+        out = {
+            "steps": int(times.size),
+            "mean_ms": float(times.mean() * 1e3),
+            "p50_ms": float(np.percentile(times, 50) * 1e3),
+            "p95_ms": float(np.percentile(times, 95) * 1e3),
+        }
+        if items_per_step:
+            out["items_per_s"] = float(items_per_step / times.mean())
+        return out
+
+
+def device_duty_cycle(step_fn, carry, *args, iters: int = 10) -> float:
+    """Estimate the device-busy fraction for a compiled step (the TPU analog
+    of the reference's "avg GPU util" column, result.png).
+
+    ``step_fn(carry, *args)`` must return a tuple whose first element is the
+    next carry (the TrainState convention) — chaining keeps donated buffers
+    valid. Runs ``iters`` dependent executions twice: once timing only the
+    async-dispatched chain (one sync at the end), once syncing every step
+    (adds one host round-trip per step). busy ≈ chain_time / stepped_time;
+    1.0 means the host never starves the device.
+    """
+    import jax
+
+    def sync(x):
+        leaf = jax.tree.leaves(x)[0]
+        np.asarray(jax.device_get(leaf))  # a value fetch cannot lie
+
+    out = step_fn(carry, *args)
+    carry = out[0]
+    sync(out[1:] if len(out) > 1 else out)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step_fn(carry, *args)
+        carry = out[0]
+    sync(carry)
+    chain = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step_fn(carry, *args)
+        carry = out[0]
+        sync(out[1] if len(out) > 1 else carry)
+    stepped = time.perf_counter() - t0
+    return min(chain / max(stepped, 1e-9), 1.0)
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics (rank-0-gated by the caller, like every
+    reference print)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+        else:
+            self._f = None
+
+    def log(self, **record) -> None:
+        if self._f is None:
+            return
+        record.setdefault("ts", time.time())
+        self._f.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
