@@ -41,7 +41,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
-    attention: str = "dense"  # dense | blockwise | ring
+    attention: str = "dense"  # dense | blockwise | flash | ring
     block_size: int = 512  # kv block for blockwise attention
     seq_axis: str = SEQ_AXIS  # mesh axis for attention="ring"
     # Megatron-style tensor parallelism: set model_axis to the mesh's model
@@ -107,6 +107,14 @@ class Attention(nn.Module):
                 q, k, v, causal=True, block_size=min(cfg.block_size, l),
                 q_offset=position_offset, k_offset=position_offset,
             )
+        elif cfg.attention == "flash":
+            from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+            # Pallas kernel path. The kernel masks from position 0, which is
+            # exact for any equal-offset self-attention: the causal
+            # predicate (k_off + j <= q_off + i) is offset-invariant when
+            # q_off == k_off, as it is here.
+            out = flash_attention(q, k, v, causal=True)
         elif cfg.attention == "dense":
             out = dense_attention(
                 q, k, v, causal=True,

@@ -3,6 +3,16 @@ from pytorch_distributed_tpu.ops.attention import (
     blockwise_attention,
     dense_attention,
 )
+
+
+def __getattr__(name):
+    # Lazy: flash_attention pulls in pallas/pltpu; environments without
+    # them keep every other op usable and fail only when flash is chosen.
+    if name == "flash_attention":
+        from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.metrics import topk_correct, ClassificationMetrics
 from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay, build_optimizer

@@ -1,0 +1,198 @@
+"""FlashAttention forward as a Pallas TPU kernel.
+
+The blockwise kernel (``ops.attention.blockwise_attention``) is the XLA-fused
+reference; this is the hand-tiled fast path for the same math, built per the
+TPU Pallas playbook (/opt/skills/guides/pallas_guide.md):
+
+- grid (B·H, Lq/block_q, Lk/block_k), KV innermost and sequential
+  ("arbitrary" dimension semantics — it carries the online-softmax
+  recurrence); Q/K/V blocks staged HBM→VMEM by BlockSpec index maps;
+- the running (m, l, acc) state lives in VMEM scratch, persisting across the
+  KV sweep for each Q block; everything accumulates in fp32 while inputs can
+  be bf16 feeding the MXU (``preferred_element_type=f32``);
+- causal masking skips fully-masked KV blocks with ``pl.when`` (no FLOPs
+  spent above the diagonal — the compute saving the plain ring schedule
+  lacks) and applies a multiplicative mask so fully-masked rows yield zeros
+  (same contract as ``attend_block``);
+- backward differentiates the blockwise jnp path via ``jax.custom_vjp``
+  (rematerialized, O(L·block) memory) — a hand-written Pallas backward is
+  the natural next step, the seam is already in place.
+
+Shapes follow the framework convention ``[B, L, H, D]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Pallas is a hard dependency of THIS module only: the ops package exports
+# flash_attention lazily, so environments without pallas keep every other
+# attention path working and fail loudly only when flash is actually chosen.
+
+from pytorch_distributed_tpu.ops.attention import NEG_INF, blockwise_attention
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _block():
+        # Fold the softmax scale into Q: one [block_q, D] multiply instead
+        # of a [block_q, block_k] one on the logits.
+        q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype))  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            mask = k_pos <= q_pos
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        if causal:
+            p = p * mask  # fully-masked rows stay all-zero (l == 0 → out 0)
+        corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # A KV block strictly above the diagonal contributes nothing — skip
+        # its FLOPs entirely.
+        pl.when(k_start <= q_start + block_q - 1)(_block)
+    else:
+        _block()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:, :1], 1e-37)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd(
+    q3, k3, v3, scale, causal, block_q, block_k, interpret
+):
+    """[BH, L, D] inputs → [BH, Lq, D]."""
+    bh, lq, d = q3.shape
+    lk = k3.shape[1]
+    grid = (bh, lq // block_q, lk // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running row max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running row sum l
+            pltpu.VMEM((block_q, d), jnp.float32),  # un-normalized output
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    to3 = lambda x, l: jnp.moveaxis(x, 2, 1).reshape(b * h, l, d)
+    o3 = _flash_fwd(
+        to3(q, lq), to3(k, lk), to3(v, lk), scale, causal, block_q, block_k,
+        interpret,
+    )
+    return jnp.moveaxis(o3.reshape(b, h, lq, d), 1, 2)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # Rematerialized blockwise backward (bit-matches the forward math up to
+    # accumulation order); a Pallas backward kernel slots in here later.
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, scale=scale, block_size=block_k
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """FlashAttention: ``softmax(QKᵀ·scale)V`` tiled through VMEM.
+
+    Args:
+      q, k, v: ``[B, L, H, D]``; each L must be a multiple of its block size
+        (blocks are clamped to L for short sequences).
+      interpret: run the kernel in the Pallas interpreter (CPU testing).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    lq, lk = q.shape[1], k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"sequence lengths ({lq}, {lk}) must be multiples of the block "
+            f"sizes ({block_q}, {block_k})"
+        )
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

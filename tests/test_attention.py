@@ -94,3 +94,71 @@ def test_indivisible_block_raises():
     q, k, v = qkv(l=30)
     with pytest.raises(ValueError):
         blockwise_attention(q, k, v, block_size=16)
+
+
+# ---- Pallas flash attention (interpret mode: same kernel, CPU executed) ----
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = qkv(l=64, d=16)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16, interpret=True
+    )
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_grads_match_dense():
+    from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = qkv(l=32, d=16)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16, interpret=True
+        )
+        return jnp.sum(out**2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_indivisible_raises():
+    from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = qkv(l=30)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+
+
+def test_flash_lm_forward_matches_dense():
+    from pytorch_distributed_tpu.models.transformer import TransformerLM, tiny_config
+
+    # interpret-mode flash inside the full model on CPU
+    import importlib
+
+    fa = importlib.import_module("pytorch_distributed_tpu.ops.flash_attention")
+
+    cfg_d = tiny_config(attention="dense")
+    cfg_f = tiny_config(attention="flash")
+    tokens = jnp.asarray(np.random.default_rng(0).integers(1, 128, (2, 32)), jnp.int32)
+    model_d = TransformerLM(cfg_d)
+    variables = model_d.init(jax.random.key(0), tokens)
+    out_d = model_d.apply(variables, tokens)
+    orig = fa.flash_attention
+    try:
+        fa.flash_attention = lambda *a, **kw: orig(*a, **{**kw, "interpret": True})
+        out_f = TransformerLM(cfg_f).apply(variables, tokens)
+    finally:
+        fa.flash_attention = orig
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_d), rtol=2e-4, atol=2e-5
+    )
