@@ -1,0 +1,151 @@
+"""Mixture-of-Experts MLP with expert parallelism (GShard/Switch style).
+
+Absent from the reference (SURVEY.md §2c: EP out of scope) but part of this
+framework's first-class parallelism set. TPU-first shape discipline
+throughout: routing is static-shape capacity-based dispatch (one-hot
+einsums, no gather/scatter, no data-dependent shapes), so the whole layer
+compiles into the surrounding step.
+
+Expert parallelism rides the **data** axis: DP ranks hold different tokens
+and different expert shards (the classic GShard identification of the
+expert axis with the data axis), so a single ``lax.all_to_all`` per
+direction moves each token to its expert's owner and back. Expert weights
+are stored GLOBAL-shaped ``[E, ...]`` and sharded by placement
+(``P(data)`` on the expert dim — same design as the TP rules), which keeps
+checkpoints layout-independent; gradients of sharded expert weights are
+local to their owner, handled by the spec-driven reduction in
+``train.lm.make_lm_train_step``.
+
+Routing: top-1 (Switch Transformer) with capacity ``ceil(cf · T / E)``;
+over-capacity tokens fall through to the residual path. The Switch
+load-balancing auxiliary loss is sowed (pre-weighted) into the
+``aux_loss`` collection; the LM step collects and adds it.
+
+Interaction with tensor parallelism: MoE blocks do NOT partition over the
+model axis — under TP every model rank computes the full expert MLP
+redundantly (replicated activations in, replicated out, identical grads).
+Correct, but TP buys no FLOPs in MoE layers; partitioning the expert hidden
+dim over the model axis is the planned follow-up.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def top1_dispatch(
+    router_logits: jax.Array,  # [T, E] fp32
+    capacity: int,
+):
+    """Static-shape top-1 routing.
+
+    Returns (dispatch [T, E, C] f32 0/1, combine [T, E, C] f32 gate-weighted,
+    aux_loss scalar). Tokens beyond an expert's capacity are dropped
+    (all-zero rows in dispatch ⇒ the layer contributes nothing for them).
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
+
+    # Position of each token within its chosen expert's buffer (0-based);
+    # non-chosen entries contribute 0, so the row-sum is exactly the
+    # chosen-expert position.
+    position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E]
+    pos_tok = jnp.sum(position, axis=-1).astype(jnp.int32)  # [T]
+    keep_tok = (pos_tok < capacity).astype(jnp.float32)  # [T]
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)[:, None, :]
+        * keep_tok[:, None, None]
+    )  # [T, E, C]
+
+    gate = jnp.sum(probs * onehot, axis=-1)  # [T] chosen-expert prob
+    combine = dispatch * gate[:, None, None]
+
+    # Switch load-balancing loss: E · Σ_e (token fraction)·(mean prob).
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Switch-style MoE replacement for the dense transformer MLP.
+
+    Attributes mirror TransformerConfig: ``n_experts`` global experts with
+    hidden width ``mlp_dim``; ``ep_size``/``expert_axis`` enable expert
+    parallelism over a mesh axis (weights locally ``[E/ep, ...]`` under
+    shard_map, globally ``[E, ...]``).
+    """
+
+    n_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    ep_size: int = 1
+    expert_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, l, d = x.shape
+        t = b * l
+        e = self.n_experts
+        e_local = e // self.ep_size
+        x_flat = x.reshape(t, d)
+
+        router = nn.Dense(e, use_bias=False, dtype=jnp.float32, name="router")
+        logits = router(x_flat.astype(jnp.float32))
+        import math
+
+        capacity = max(math.ceil(self.capacity_factor * t / e), 1)
+        dispatch, combine, aux = top1_dispatch(logits, capacity)
+        self.sow("aux_loss", "moe", self.aux_loss_weight * aux)
+
+        w_up = self.param(
+            "w_up",
+            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
+            (e_local, d, self.mlp_dim),
+        )
+        w_down = self.param(
+            "w_down",
+            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
+            (e_local, self.mlp_dim, d),
+        )
+
+        # [T, E, C] × [T, D] → per-expert buffers [E, C, D]
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(self.dtype), x_flat.astype(self.dtype)
+        )
+
+        if self.expert_axis and self.ep_size > 1:
+            # Ship each expert's buffer to its owner: [E, C, D] →
+            # [ep, E_local, C, D], exchange over the axis, gather the ep
+            # source chunks along capacity.
+            xe = expert_in.reshape(self.ep_size, e_local, capacity, d)
+            xe = jax.lax.all_to_all(
+                xe, self.expert_axis, split_axis=0, concat_axis=0, tiled=False
+            )  # [ep(src), E_local, C, D]
+            xe = jnp.moveaxis(xe, 0, 1).reshape(e_local, self.ep_size * capacity, d)
+        else:
+            xe = expert_in  # [E(=E_local), C, D]
+
+        h = jnp.einsum("ecd,edf->ecf", xe, w_up.astype(self.dtype))
+        h = nn.gelu(h)
+        ye = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
+
+        if self.expert_axis and self.ep_size > 1:
+            ye = ye.reshape(e_local, self.ep_size, capacity, d)
+            ye = jnp.moveaxis(ye, 1, 0)  # [ep(src), E_local, C, D]
+            ye = jax.lax.all_to_all(
+                ye, self.expert_axis, split_axis=0, concat_axis=0, tiled=False
+            )  # back at the token owner: [ep(dest), E_local, C, D]
+            ye = ye.reshape(e, capacity, d)
+
+        out = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), ye)
+        return out.reshape(b, l, d)

@@ -52,8 +52,23 @@ class TransformerConfig:
     # feature widths flax should expect at apply time. None/1 = no TP.
     model_axis: Optional[str] = None
     tp_size: int = 1
+    # Mixture-of-Experts (models/moe.py): n_experts > 0 replaces the dense
+    # MLP with a Switch-style MoE in every ``moe_every``-th block. Expert
+    # parallelism rides the data axis: set expert_axis/ep_size to the mesh's
+    # data axis name/size (weights stay global-shaped; placement shards
+    # them, like TP).
+    n_experts: int = 0
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
 
     def __post_init__(self):
+        if self.n_experts and self.n_experts % self.ep_size:
+            raise ValueError(
+                f"n_experts {self.n_experts} not divisible by ep_size {self.ep_size}"
+            )
         if self.embed_dim % self.num_heads:
             raise ValueError(
                 f"embed_dim {self.embed_dim} not divisible by num_heads {self.num_heads}"
@@ -136,6 +151,7 @@ class Attention(nn.Module):
 
 class Block(nn.Module):
     config: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, position_offset):
@@ -143,6 +159,19 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + Attention(cfg, name="attn")(h, position_offset)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        if self.use_moe:
+            from pytorch_distributed_tpu.models.moe import MoEMLP
+
+            return x + MoEMLP(
+                n_experts=cfg.n_experts,
+                mlp_dim=cfg.embed_dim * cfg.mlp_ratio,
+                capacity_factor=cfg.capacity_factor,
+                aux_loss_weight=cfg.moe_aux_weight,
+                ep_size=cfg.ep_size,
+                expert_axis=cfg.expert_axis,
+                dtype=cfg.dtype,
+                name="moe",
+            )(h)
         if cfg.model_axis:
             from pytorch_distributed_tpu.parallel.tensor import tp_copy, tp_reduce
 
@@ -177,7 +206,8 @@ class TransformerLM(nn.Module):
         pos = position_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype, name="wpe")(pos)
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"block{i}")(x, position_offset)
+            use_moe = bool(cfg.n_experts) and (i % cfg.moe_every == cfg.moe_every - 1)
+            x = Block(cfg, use_moe=use_moe, name=f"block{i}")(x, position_offset)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -71,7 +71,8 @@ def create_lm_state(
     # not exist at init) and no TP collectives. Parameter shapes are global
     # either way, so the produced tree serves every parallel layout.
     dense_cfg = dataclasses.replace(
-        config, attention="dense", model_axis=None, tp_size=1
+        config, attention="dense", model_axis=None, tp_size=1,
+        expert_axis=None, ep_size=1,
     )
     init_model = TransformerLM(dense_cfg)
     state = TrainState.create(
@@ -96,16 +97,47 @@ TRANSFORMER_TP_RULES = (
     (r"mlp_down/kernel", P(MODEL_AXIS, None)),  # [4E,E] → 4E
 )
 
+# MoE expert weights: sharded on the expert dim over the DATA axis (GShard
+# expert parallelism; models/moe.py). Only applied when the config actually
+# runs expert-parallel (ep_size == data-axis size) — with ep_size=1 the
+# experts must stay replicated or the module's declared shapes mismatch.
+MOE_EP_RULE = (r"moe/w_(up|down)", P(DATA_AXIS))
 
-def lm_state_specs(state: TrainState, rules=TRANSFORMER_TP_RULES) -> TrainState:
-    """PartitionSpec pytree shaped like ``state``: params by the TP rules,
-    optimizer state following its embedded parameter copies, everything
-    else replicated."""
+
+def _has_moe_params(params) -> bool:
+    from pytorch_distributed_tpu.parallel.tensor import path_str
+
+    return any(
+        "moe/w_" in path_str(p)
+        for p, _ in jax.tree_util.tree_flatten_with_path(params)[0]
+    )
+
+
+def lm_state_specs(state: TrainState, rules=None, config=None) -> TrainState:
+    """PartitionSpec pytree shaped like ``state``: params by the TP (and,
+    when the config runs expert-parallel, EP) rules, optimizer state
+    following its embedded parameter copies, everything else replicated.
+
+    ``config`` (the TransformerConfig) is required when the params contain
+    MoE experts — whether they shard over the data axis depends on its
+    ``ep_size``, which the parameter tree alone cannot reveal.
+    """
     from pytorch_distributed_tpu.parallel.tensor import (
         match_partition_rules,
         opt_state_specs,
     )
 
+    if rules is None:
+        rules = TRANSFORMER_TP_RULES
+        if _has_moe_params(state.params):
+            if config is None:
+                raise ValueError(
+                    "state contains MoE expert weights; pass the "
+                    "TransformerConfig so their placement (ep_size/"
+                    "expert_axis) is known"
+                )
+            if config.expert_axis is not None and config.ep_size > 1:
+                rules = rules + (MOE_EP_RULE,)
     param_specs = match_partition_rules(rules, state.params)
     return state.replace(
         step=P(),
@@ -116,13 +148,30 @@ def lm_state_specs(state: TrainState, rules=TRANSFORMER_TP_RULES) -> TrainState:
     )
 
 
-def shard_lm_state(mesh: Mesh, state: TrainState) -> Tuple[TrainState, TrainState]:
-    """Place a (host or replicated) state onto the mesh per the TP rules.
+def shard_lm_state(
+    mesh: Mesh, state: TrainState, config=None
+) -> Tuple[TrainState, TrainState]:
+    """Place a (host or replicated) state onto the mesh per the TP/EP rules.
 
     Returns (placed_state, spec_state). For tp=1 meshes the specs shard
     nothing (every spec axis has size 1) and this is plain replication.
+    ``config`` is required for MoE models (see ``lm_state_specs``) and is
+    validated against the mesh: expert parallelism must span exactly the
+    data axis.
     """
-    specs = lm_state_specs(state)
+    if config is not None and config.ep_size > 1:
+        if config.expert_axis != DATA_AXIS:
+            raise ValueError(
+                f"expert_axis must be {DATA_AXIS!r} (the EP placement rule "
+                f"shards experts over it), got {config.expert_axis!r}"
+            )
+        if config.ep_size != mesh.shape[DATA_AXIS]:
+            raise ValueError(
+                f"ep_size {config.ep_size} must equal the mesh's data axis "
+                f"size {mesh.shape[DATA_AXIS]} (experts shard over the full "
+                "data axis)"
+            )
+    specs = lm_state_specs(state, config=config)
     shardings = jax.tree.map(
         lambda s: jax.sharding.NamedSharding(mesh, s),
         specs,
@@ -158,9 +207,14 @@ def make_lm_train_step(
         # would scale the gradient by the axis size.
         global_count = jax.lax.psum(jnp.sum(batch["weights"]), axes)
 
+        n_shards = jax.lax.psum(1, axes)
+
         def loss_fn(params):
-            logits = state.apply_fn(
-                {"params": params}, batch["tokens"], position_offset=offset
+            logits, mutated = state.apply_fn(
+                {"params": params},
+                batch["tokens"],
+                position_offset=offset,
+                mutable=["aux_loss"],
             )
             per_tok = cross_entropy_loss(
                 logits.reshape(-1, logits.shape[-1]),
@@ -168,14 +222,34 @@ def make_lm_train_step(
                 reduction="none",
             )
             w = batch["weights"].reshape(-1)
-            # This device's share of the global mean loss.
-            return jnp.sum(per_tok * w) / jnp.maximum(global_count, 1.0)
+            # This device's share of the global mean loss; sowed auxiliary
+            # losses (MoE load balancing, pre-weighted) enter as their
+            # across-shards mean.
+            local = jnp.sum(per_tok * w) / jnp.maximum(global_count, 1.0)
+            for leaf in jax.tree.leaves(mutated.get("aux_loss", {})):
+                local = local + leaf / n_shards
+            return local
 
         # local_loss_i = s_i / C  ⇒  psum(grad local_loss_i) = grad of the
         # global mean loss w.r.t. the replicated params.
         local_loss, grads = jax.value_and_grad(loss_fn)(state.params)
         loss = jax.lax.psum(local_loss, axes)
-        grads = jax.lax.psum(grads, axes)
+        if state_specs is None:
+            grads = jax.lax.psum(grads, axes)
+        else:
+            # A parameter sharded over some axis (TP over model, EP over
+            # data) owns its gradient there; psum only over the axes its
+            # spec does NOT shard.
+            def _reduce(g, spec):
+                named = set()
+                for part in spec:
+                    if part is None:
+                        continue
+                    named.update(part if isinstance(part, tuple) else (part,))
+                ax = tuple(a for a in axes if a not in named)
+                return jax.lax.psum(g, ax) if ax else g
+
+            grads = jax.tree.map(_reduce, grads, state_specs.params)
         count = global_count
 
         updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)

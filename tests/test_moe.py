@@ -1,0 +1,182 @@
+"""Mixture-of-Experts: routing math, single-device correctness, and
+expert-parallel (data-axis all_to_all) training parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.models.moe import MoEMLP, top1_dispatch
+from pytorch_distributed_tpu.models.transformer import tiny_config
+from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
+from pytorch_distributed_tpu.parallel import make_mesh
+from pytorch_distributed_tpu.train.lm import (
+    create_lm_state,
+    lm_state_specs,
+    make_lm_train_step,
+    shard_lm_state,
+    shift_labels,
+)
+
+
+def test_top1_dispatch_capacity_and_positions():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+    dispatch, combine, aux = top1_dispatch(logits, capacity=3)
+    d = np.asarray(dispatch)
+    # every expert buffer slot holds at most one token
+    assert (d.sum(axis=0) <= 1.0 + 1e-6).all()
+    # every kept token occupies exactly one (expert, slot); dropped are zero
+    per_tok = d.sum(axis=(1, 2))
+    assert set(np.round(per_tok).astype(int)) <= {0, 1}
+    # expert load never exceeds capacity
+    assert (d.sum(axis=(0, 2)) <= 3 + 1e-6).all()
+    # combine carries the router prob on the same slots
+    c = np.asarray(combine)
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    gate = probs.max(axis=-1)
+    np.testing.assert_allclose(c.sum(axis=(1, 2)), gate * per_tok, rtol=1e-5)
+    assert float(aux) > 0
+
+
+def test_top1_dispatch_drops_over_capacity():
+    # all tokens pick expert 0; capacity 2 keeps exactly the first 2
+    logits = jnp.asarray(np.tile([5.0, 0.0], (6, 1)), jnp.float32)
+    dispatch, _, _ = top1_dispatch(logits, capacity=2)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == 2.0 and d[:2, 0].sum() == 2.0
+    assert d[2:].sum() == 0.0
+
+
+def test_moe_mlp_matches_manual_expert_computation():
+    m = MoEMLP(n_experts=4, mlp_dim=16, capacity_factor=4.0, aux_loss_weight=0.0)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8)), jnp.float32)
+    variables = m.init(jax.random.key(0), x)
+    out, _ = m.apply(variables, x, mutable=["aux_loss"])
+
+    p = variables["params"]
+    logits = np.asarray(x.reshape(16, 8) @ np.asarray(p["router"]["kernel"]))
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    chosen = probs.argmax(-1)
+    w_up, w_down = np.asarray(p["w_up"]), np.asarray(p["w_down"])
+    xf = np.asarray(x.reshape(16, 8))
+    expect = np.zeros_like(xf)
+    for t in range(16):
+        e = chosen[t]
+        h = np.asarray(jax.nn.gelu(jnp.asarray(xf[t] @ w_up[e])))
+        expect[t] = probs[t, e] * (h @ w_down[e])
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(16, 8), expect, rtol=1e-4, atol=1e-5
+    )
+
+
+def lm_run(mesh, ep, steps=3):
+    dp = mesh.shape["data"]
+    cfg = tiny_config(
+        attention="ring" if mesh.shape["seq"] > 1 else "dense",
+        n_experts=4,
+        moe_every=2,
+        # no drops on any layout (capacity >= local tokens) and no aux loss:
+        # per-shard aux means differ from the global mean, breaking parity
+        capacity_factor=float(4 * 8),
+        moe_aux_weight=0.0,
+        expert_axis="data" if ep > 1 else None,
+        ep_size=ep,
+    )
+    tx = sgd_with_weight_decay(0.1, momentum=0.9)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    state, specs = shard_lm_state(mesh, state, cfg)
+    step_fn = make_lm_train_step(mesh, state_specs=specs)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(1, 128, (4, 32)).astype(np.int32)
+    labels, weights = shift_labels(tokens)
+    sh = NamedSharding(mesh, P("data", "seq"))
+    batch = {
+        "tokens": jax.device_put(tokens, sh),
+        "labels": jax.device_put(labels, sh),
+        "weights": jax.device_put(weights, sh),
+    }
+    losses = []
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    return state, losses
+
+
+def test_expert_parallel_matches_single_device(devices8):
+    mesh_ep = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    mesh_1 = make_mesh(devices8[:1])
+    state_ep, losses_ep = lm_run(mesh_ep, ep=4)
+    state_1, losses_1 = lm_run(mesh_1, ep=1)
+    np.testing.assert_allclose(losses_ep, losses_1, rtol=5e-4)
+    flat_1 = {
+        str(p): v for p, v in jax.tree_util.tree_leaves_with_path(state_1.params)
+    }
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state_ep.params):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_1[str(path)]),
+            rtol=2e-3, atol=3e-5, err_msg=str(path),
+        )
+
+
+def test_expert_weights_sharded_over_data(devices8):
+    mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    cfg = tiny_config(n_experts=4, expert_axis="data", ep_size=4)
+    tx = sgd_with_weight_decay(0.1)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    state, specs = shard_lm_state(mesh, state, cfg)
+    w_up = state.params["block1"]["moe"]["w_up"]  # block1 is the MoE block
+    shapes = {s.data.shape for s in w_up.addressable_shards}
+    assert shapes == {(1, 32, 128)}  # 4 experts / 4 data ranks
+    assert specs.params["block1"]["moe"]["w_up"] == P("data")
+
+
+def test_moe_replicated_experts_on_dp_mesh(devices8):
+    """ep_size=1 on a dp>1 mesh: experts stay REPLICATED (no EP rule) and
+    training still matches single-device — regression for the rule that
+    used to shard experts over the full data axis unconditionally."""
+    mesh_dp = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    mesh_1 = make_mesh(devices8[:1])
+    state_dp, losses_dp = lm_run(mesh_dp, ep=1)
+    state_1, losses_1 = lm_run(mesh_1, ep=1)
+    np.testing.assert_allclose(losses_dp, losses_1, rtol=5e-4)
+    w_up = state_dp.params["block1"]["moe"]["w_up"]
+    assert {s.data.shape for s in w_up.addressable_shards} == {(4, 32, 128)}
+
+
+def test_shard_lm_state_validates_ep(devices8):
+    mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    cfg = tiny_config(n_experts=4, expert_axis="data", ep_size=2)  # != dp
+    tx = sgd_with_weight_decay(0.1)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    with pytest.raises(ValueError, match="ep_size"):
+        shard_lm_state(mesh, state, cfg)
+    with pytest.raises(ValueError, match="MoE"):
+        lm_state_specs(state)  # config required for MoE params
+
+
+def test_moe_aux_loss_trains(devices8):
+    mesh = make_mesh(devices8[:1])
+    cfg = tiny_config(n_experts=4, moe_aux_weight=0.01)
+    tx = sgd_with_weight_decay(0.2, momentum=0.9)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    state, specs = shard_lm_state(mesh, state, cfg)
+    step_fn = make_lm_train_step(mesh, state_specs=specs)
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(1, 128, (2, 16)).astype(np.int32)
+    labels, weights = shift_labels(tokens)
+    sh = NamedSharding(mesh, P("data", "seq"))
+    batch = {
+        "tokens": jax.device_put(tokens, sh),
+        "labels": jax.device_put(labels, sh),
+        "weights": jax.device_put(weights, sh),
+    }
+    first = last = None
+    for _ in range(8):
+        state, metrics = step_fn(state, batch)
+        loss = float(metrics["loss"])
+        first = first if first is not None else loss
+        last = loss
+    assert np.isfinite(last) and last < first
