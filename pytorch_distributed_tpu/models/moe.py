@@ -30,6 +30,7 @@ dim over the model axis is the planned follow-up.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import flax.linen as nn
@@ -101,8 +102,6 @@ class MoEMLP(nn.Module):
 
         router = nn.Dense(e, use_bias=False, dtype=jnp.float32, name="router")
         logits = router(x_flat.astype(jnp.float32))
-        import math
-
         capacity = max(math.ceil(self.capacity_factor * t / e), 1)
         dispatch, combine, aux = top1_dispatch(logits, capacity)
         self.sow("aux_loss", "moe", self.aux_loss_weight * aux)

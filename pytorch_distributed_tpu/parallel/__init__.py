@@ -18,6 +18,7 @@ from pytorch_distributed_tpu.parallel.distributed import (
     init_process_group,
     is_primary,
 )
+from pytorch_distributed_tpu.parallel.pipeline import gpipe, last_stage_value
 from pytorch_distributed_tpu.parallel.sequence import (
     ring_attention,
     ring_attention_sharded,
@@ -46,6 +47,8 @@ __all__ = [
     "get_world_size",
     "is_primary",
     "barrier",
+    "gpipe",
+    "last_stage_value",
     "ring_attention",
     "ring_attention_sharded",
     "all_reduce",

@@ -1,0 +1,98 @@
+"""Pipeline parallelism: a GPipe-style microbatch executor under shard_map.
+
+Completes the framework's parallelism taxonomy (dp/sp/tp/ep in
+``mesh``/``sequence``/``tensor``/``models.moe``; pp here — all absent from
+the reference, SURVEY.md §2c). TPU-first shape discipline:
+
+- stages live on the ``model`` mesh axis; stage s holds its own slice of
+  the layer stack (placement-sharded params, like TP/EP);
+- the schedule is one ``lax.scan`` over M + S - 1 ticks; each tick every
+  stage computes its current microbatch and ``ppermute``s the activation to
+  its successor — the classic GPipe pipeline with bubble fraction
+  (S-1)/(M+S-1), all static shapes, no data-dependent control flow;
+- warm-up/drain bubbles are computed-but-masked (XLA cannot skip them
+  without dynamic shapes); outputs are collected at the LAST stage and are
+  valid there — combine with an out_spec that reads the final stage's
+  shard, or psum-mask as needed by the caller;
+- the whole schedule differentiates through scan + ppermute, so the same
+  executor trains (backward replays the ring in reverse).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.parallel.mesh import MODEL_AXIS
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    axis: str = MODEL_AXIS,
+    remat: bool = True,
+) -> jax.Array:
+    """Run microbatches through the stage pipeline (call under shard_map).
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` — one stage's computation; every
+        stage must map the same activation shape to itself (uniform-width
+        pipeline, e.g. a slice of transformer blocks).
+      stage_params: THIS stage's parameters (the local shard of a
+        stage-stacked tree).
+      microbatches: ``[M, ...]`` — the full input, identical on every stage
+        (stage 0 consumes it; others ignore theirs).
+
+    Returns: ``[M, ...]`` outputs, VALID ON THE LAST STAGE (other stages
+    hold garbage from their position in the ring) — select stage S-1's
+    copy, e.g. via ``jax.lax.ppermute`` broadcast or an outer psum-mask.
+    """
+    s = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    m = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+
+    # Send each stage's activation to its successor; the ring wraps only to
+    # keep the permutation total (stage 0 ignores what it receives).
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # Stage 0 feeds microbatch t while t < M; later stages consume what
+        # arrived from their predecessor last tick.
+        feed = microbatches[jnp.clip(t, 0, m - 1)]
+        x = jnp.where(my == 0, feed, incoming)
+        y = stage_fn(stage_params, x)
+        # The last stage banks its result at output slot t - (S-1) (valid
+        # once the pipeline is full).
+        slot = jnp.clip(t - (s - 1), 0, m - 1)
+        valid = (t >= s - 1) & (jnp.asarray(my) == s - 1)
+        current = jax.lax.dynamic_index_in_dim(outputs, slot, keepdims=False)
+        banked = jnp.where(valid, y, current)
+        outputs = jax.lax.dynamic_update_index_in_dim(outputs, banked, slot, 0)
+        incoming = jax.lax.ppermute(y, axis, perm)
+        return (incoming, outputs), None
+
+    if remat:
+        tick = jax.checkpoint(tick)
+
+    init = (
+        jnp.zeros(mb_shape, microbatches.dtype),
+        jnp.zeros((m,) + mb_shape, microbatches.dtype),
+    )
+    (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(m + s - 1))
+    return outputs
+
+
+def last_stage_value(x: jax.Array, axis: str = MODEL_AXIS) -> jax.Array:
+    """Broadcast the LAST stage's copy of ``x`` to every stage (psum-mask —
+    one collective), turning gpipe's stage-local outputs into a replicated
+    value usable by loss code on any stage."""
+    s = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    mask = (my == s - 1).astype(x.dtype)
+    return jax.lax.psum(x * mask, axis)
