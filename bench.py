@@ -13,9 +13,26 @@ single-device comparison; the reference's own best AMP 8-GPU config averages
 ≈693 img/s per GPU, so vs_baseline ≳ 1 also implies per-chip parity with
 their headline config.
 
-Batch size: 128 by default (best measured on v5e; see the sweep comment in
-main()), halved automatically on RESOURCE_EXHAUSTED; override with
-BENCH_BS. BENCH_TINY=1 runs a toy model for CI/CPU smoke.
+Timing method (see PERF_NOTES.md for the round-2 investigation): the
+tunneled TPU runtime has ~95 ms host↔device round-trip latency and
+``block_until_ready`` does not reliably block, so the loop dispatches all
+iterations asynchronously (donated state chains them on device) and syncs
+ONCE at the end by fetching the scalar loss; the single round-trip is
+subtracted. ``duty_cycle`` is measured from a ``jax.profiler`` trace
+(device-busy time / wall), replacing round 1's per-step-sync estimate that
+mostly measured tunnel latency.
+
+Extra fields: ``fp32_img_s`` reproduces the reference's single-device fp32
+row on the same chip (skip with BENCH_FP32=0); ``step_ms`` is the amortized
+per-step wall time of the headline config.
+
+Batch size: 128 by default (sweep on v5e, round 2: 64→2421, 128→2752,
+192→2114, 256→2592/2 img/s — 128 is the knee; the step is HBM-bandwidth-
+bound, see PERF_NOTES.md), halved automatically on RESOURCE_EXHAUSTED;
+override with BENCH_BS. BENCH_TINY=1 runs a toy model for CI/CPU smoke.
+
+scripts/bench_table.py renders the reference's result.png-shaped
+single/DP/DDP/AMP comparison table (BENCH_TABLE.md).
 """
 
 from __future__ import annotations
@@ -31,7 +48,28 @@ import numpy as np
 BASELINE_IMG_S = 1_281_167 / 1786.7849  # single-A100 row, BASELINE.md
 
 
-def build(batch_size: int, tiny: bool):
+def measure_roundtrip_s(n: int = 3) -> float:
+    """Host↔device round-trip cost of one scalar value fetch.
+
+    ~95 ms through the axon tunnel, ~0 on a local backend; measured rather
+    than hardcoded so the subtraction below never corrupts local runs.
+    """
+    x = jnp.zeros(())
+    f = jax.jit(lambda v: v + 1)
+    float(f(x))  # compile
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        float(f(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def build(batch_size: int, tiny: bool, dtype=jnp.bfloat16, mesh=None):
+    """State/step/batch for a bench run. ``batch_size`` is the GLOBAL batch
+    (sharded over the mesh's data axis; a 1-device mesh makes it per-chip).
+    ``mesh`` defaults to one device; scripts/bench_table.py passes multi-
+    device meshes to exercise the DP rows with the same timing method."""
     from pytorch_distributed_tpu.models import resnet50
     from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
     from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
@@ -46,11 +84,12 @@ def build(batch_size: int, tiny: bool):
     image_size = 32 if tiny else 224
     if tiny:
         model = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=100,
-                       num_filters=8, dtype=jnp.bfloat16)
+                       num_filters=8, dtype=dtype)
     else:
-        model = resnet50(dtype=jnp.bfloat16)
+        model = resnet50(dtype=dtype)
 
-    mesh = single_device_mesh()
+    if mesh is None:
+        mesh = single_device_mesh()
     tx = sgd_with_weight_decay(0.1, momentum=0.9, weight_decay=1e-4)
     state = TrainState.create(
         model, tx, jax.random.key(0), (1, image_size, image_size, 3)
@@ -71,10 +110,11 @@ def build(batch_size: int, tiny: bool):
     return state, step, batch
 
 
-def run(batch_size: int, tiny: bool, warmup: int = 10, iters: int = 30):
+def run(batch_size: int, tiny: bool, dtype=jnp.bfloat16, warmup: int = 8,
+        iters: int = 30, measure_duty: bool = True, mesh=None):
     from pytorch_distributed_tpu.utils.profiling import device_duty_cycle
 
-    state, step, batch = build(batch_size, tiny)
+    state, step, batch = build(batch_size, tiny, dtype, mesh=mesh)
     for _ in range(warmup):
         state, metrics = step(state, batch)
     # Sync by fetching a value: through tunneled TPU runtimes,
@@ -90,41 +130,62 @@ def run(batch_size: int, tiny: bool, warmup: int = 10, iters: int = 30):
     dt = time.perf_counter() - t0
     if not np.isfinite(loss):
         raise RuntimeError(f"non-finite loss {loss}")
-    duty = device_duty_cycle(step, state, batch, iters=10)
-    return batch_size * iters / dt, duty
+    # One value-fetch round-trip sits in the window; subtract the measured
+    # cost, but never more than half the window (guards tiny/fast runs).
+    dt = max(dt - measure_roundtrip_s(), dt / 2)
+    duty = float("nan")
+    if measure_duty:
+        duty = device_duty_cycle(step, state, batch, iters=min(iters, 20))
+    return batch_size * iters / dt, dt / iters, duty
 
 
 def main() -> None:
     tiny = os.environ.get("BENCH_TINY", "") == "1"
-    # bs sweep on v5e (2026-07): 128 → 2590 img/s, 256 → 2540, 512 → 2414.
     batch_size = int(os.environ.get("BENCH_BS", "64" if tiny else "128"))
     if batch_size < 1:
         raise ValueError(f"BENCH_BS must be >= 1, got {batch_size}")
     while True:
         try:
-            img_s, duty = run(batch_size, tiny)
+            img_s, step_s, duty = run(batch_size, tiny)
             break
         except Exception as e:  # XlaRuntimeError isn't a stable import path
             if "RESOURCE_EXHAUSTED" in str(e) and batch_size > 8:
                 batch_size //= 2
                 continue
             raise
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_imagenet_train_throughput_1chip"
-                if not tiny
-                else "tiny_resnet_train_throughput_1chip",
-                "value": round(img_s, 2),
-                "unit": "img/s",
-                "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
-                "duty_cycle": round(duty, 4),  # ≙ result.png "avg GPU util"
-                "batch_size": batch_size,
-                "platform": jax.devices()[0].platform,
-                "device": str(jax.devices()[0]),
-            }
-        )
-    )
+    record = {
+        "metric": "resnet50_imagenet_train_throughput_1chip"
+        if not tiny
+        else "tiny_resnet_train_throughput_1chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+        "batch_size": batch_size,
+        "step_ms": round(step_s * 1e3, 2),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+    if np.isfinite(duty):
+        record["duty_cycle"] = round(duty, 4)
+    if not tiny and os.environ.get("BENCH_FP32", "1") == "1":
+        fp32_bs = batch_size
+        while True:
+            try:
+                fp32_img_s, _, _ = run(fp32_bs, tiny, dtype=jnp.float32,
+                                       measure_duty=False)
+                record["fp32_img_s"] = round(fp32_img_s, 2)
+                record["fp32_vs_baseline"] = round(fp32_img_s / BASELINE_IMG_S, 4)
+                record["fp32_batch_size"] = fp32_bs
+                break
+            except Exception as e:
+                # fp32 needs ~2x the HBM of bf16; never lose the already-
+                # measured headline number to an fp32 OOM.
+                if "RESOURCE_EXHAUSTED" in str(e) and fp32_bs > 8:
+                    fp32_bs //= 2
+                    continue
+                record["fp32_error"] = str(e)[:200]
+                break
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

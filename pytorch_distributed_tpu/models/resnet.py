@@ -26,12 +26,47 @@ from functools import partial
 from typing import Any, Callable, Optional, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
 
 # torchvision's kaiming_normal_(mode='fan_out', nonlinearity='relu')
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class Conv1x1(nn.Module):
+    """Pointwise convolution expressed as a ``dot_general`` contraction.
+
+    A 1x1 conv IS a matmul over the channel dim; lowering it as
+    ``dot_general`` instead of ``conv_general_dilated`` steers XLA:TPU onto
+    the MXU matmul emitters in both directions. Measured on v5e (see
+    PERF_NOTES.md): exact output parity, but no step-time win — the full
+    train step is HBM-bandwidth-bound, not conv-emitter-bound — so this
+    stays an option (``ResNet.use_dot_1x1``), default off.
+
+    Parameter shape and name match ``nn.Conv`` ((1, 1, Cin, Cout) under
+    "kernel") so checkpoints are interchangeable with the conv formulation.
+    """
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+    kernel_init: Any = conv_kernel_init
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", self.kernel_init, (1, 1, x.shape[-1], self.features), jnp.float32
+        )
+        if self.strides != 1:
+            x = x[:, :: self.strides, :: self.strides, :]
+        x = x.astype(self.dtype)
+        return jax.lax.dot_general(
+            x,
+            kernel[0, 0].astype(self.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+        )
 
 
 class BasicBlock(nn.Module):
@@ -44,6 +79,8 @@ class BasicBlock(nn.Module):
 
     expansion: int = 1
 
+    pointwise: Optional[ModuleDef] = None
+
     @nn.compact
     def __call__(self, x):
         residual = x
@@ -53,12 +90,19 @@ class BasicBlock(nn.Module):
         y = self.conv(self.filters, (3, 3))(y)
         y = self.norm()(y)
         if residual.shape != y.shape:
-            residual = self.conv(
-                self.filters * self.expansion,
-                (1, 1),
-                (self.strides, self.strides),
-                name="downsample_conv",
-            )(residual)
+            if self.pointwise is not None:
+                residual = self.pointwise(
+                    self.filters * self.expansion,
+                    strides=self.strides,
+                    name="downsample_conv",
+                )(residual)
+            else:
+                residual = self.conv(
+                    self.filters * self.expansion,
+                    (1, 1),
+                    (self.strides, self.strides),
+                    name="downsample_conv",
+                )(residual)
             residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(residual + y)
 
@@ -76,23 +120,30 @@ class BottleneckBlock(nn.Module):
 
     expansion: int = 4
 
+    pointwise: Optional[ModuleDef] = None
+
     @nn.compact
     def __call__(self, x):
+        pw = self.pointwise
+        conv1x1 = (
+            (lambda f, s=1, name=None: pw(f, strides=s, name=name))
+            if pw is not None
+            else (lambda f, s=1, name=None: self.conv(f, (1, 1), (s, s), name=name))
+        )
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
+        y = conv1x1(self.filters, name="Conv_0")(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="Conv_1")(
+            y
+        )
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters * self.expansion, (1, 1))(y)
+        y = conv1x1(self.filters * self.expansion, name="Conv_2")(y)
         y = self.norm()(y)
         if residual.shape != y.shape:
-            residual = self.conv(
-                self.filters * self.expansion,
-                (1, 1),
-                (self.strides, self.strides),
-                name="downsample_conv",
+            residual = conv1x1(
+                self.filters * self.expansion, self.strides, name="downsample_conv"
             )(residual)
             residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(residual + y)
@@ -109,6 +160,11 @@ class ResNet(nn.Module):
       dtype: compute dtype (bf16 for TPU mixed precision; params stay fp32).
       bn_cross_replica_axis: mesh axis name for sync-BN under shard_map; None
         (default) keeps per-replica statistics like the reference's DDP.
+      use_dot_1x1: lower pointwise convs as dot_general (see ``Conv1x1``);
+        identical math and checkpoint layout, measured perf-neutral on v5e.
+      remat_blocks: wrap each residual block in ``jax.checkpoint``; trades
+        ~20% step time (measured v5e, bs128) for activation memory —
+        useful when batch size is HBM-limited.
     """
 
     stage_sizes: Sequence[int]
@@ -117,6 +173,8 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.float32
     bn_cross_replica_axis: Optional[str] = None
+    use_dot_1x1: bool = False
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -136,6 +194,12 @@ class ResNet(nn.Module):
             axis_name=self.bn_cross_replica_axis,
         )
 
+        pointwise = (
+            partial(Conv1x1, dtype=self.dtype, kernel_init=conv_kernel_init)
+            if self.use_dot_1x1
+            else None
+        )
+
         x = x.astype(self.dtype)
         x = conv(
             self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init"
@@ -144,14 +208,18 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
 
+        block_cls = self.block_cls
+        if self.remat_blocks:
+            block_cls = nn.remat(block_cls)
         for i, stage_size in enumerate(self.stage_sizes):
             for j in range(stage_size):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block_cls(
+                x = block_cls(
                     filters=self.num_filters * 2**i,
                     conv=conv,
                     norm=norm,
                     strides=strides,
+                    pointwise=pointwise,
                     name=f"stage{i + 1}_block{j + 1}",
                 )(x)
 

@@ -91,41 +91,111 @@ class StepTimer:
         return out
 
 
+def _scalar_sync(tree) -> None:
+    """Force completion by fetching the smallest DEVICE leaf.
+
+    Through tunneled TPU runtimes, ``block_until_ready`` has been observed to
+    return before device work drains, and device→host bandwidth can be as low
+    as ~24 MB/s — so sync on a value fetch, but fetch the cheapest one.
+    Non-array leaves (plain Python numbers) carry no device dependency and
+    must not be chosen — fetching one would be a no-op "sync".
+    """
+    import jax
+
+    device_leaves = [
+        l for l in jax.tree.leaves(tree) if isinstance(l, jax.Array)
+    ]
+    if not device_leaves:
+        return
+    leaf = min(device_leaves, key=lambda l: l.size)
+    np.asarray(jax.device_get(leaf))
+
+
+def trace_device_busy_s(trace_dir: str):
+    """Device-busy and device-active-span seconds from a ``jax.profiler``
+    trace.
+
+    Parses the Chrome-trace JSON the profiler writes, takes every complete
+    ("X") event on a device-named process track, and returns
+    ``(busy, span)``: the length of the union of their time intervals
+    (events nest, so summing durations would double-count) and the
+    first-event-start → last-event-end span. Returns None if no
+    trace/device events are found.
+    """
+    import glob
+    import gzip
+
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz"))
+    )
+    if not paths:
+        return None
+    with gzip.open(paths[-1], "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    pids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e.get("args", {}).get("name", "")
+    dev_pids = {p for p, n in pids.items() if "/device:" in n and "CPU" not in n}
+    if not dev_pids:
+        return None
+    intervals = sorted(
+        (e["ts"], e["ts"] + e.get("dur", 0))
+        for e in events
+        if e.get("ph") == "X" and e.get("pid") in dev_pids
+    )
+    if not intervals:
+        return None
+    busy = 0.0
+    cur_start, cur_end = intervals[0]
+    for start, end in intervals[1:]:
+        if start > cur_end:
+            busy += cur_end - cur_start
+            cur_start, cur_end = start, end
+        else:
+            cur_end = max(cur_end, end)
+    busy += cur_end - cur_start
+    span = max(end for _, end in intervals) - intervals[0][0]
+    # trace timestamps are microseconds
+    return busy / 1e6, span / 1e6
+
+
 def device_duty_cycle(step_fn, carry, *args, iters: int = 10) -> float:
-    """Estimate the device-busy fraction for a compiled step (the TPU analog
+    """Measure the device-busy fraction for a compiled step (the TPU analog
     of the reference's "avg GPU util" column, result.png).
 
     ``step_fn(carry, *args)`` must return a tuple whose first element is the
     next carry (the TrainState convention) — chaining keeps donated buffers
-    valid. Runs ``iters`` dependent executions twice: once timing only the
-    async-dispatched chain (one sync at the end), once syncing every step
-    (adds one host round-trip per step). busy ≈ chain_time / stepped_time;
-    1.0 means the host never starves the device.
-    """
-    import jax
+    valid. Runs ``iters`` dependent executions under a ``jax.profiler``
+    trace and returns device_busy_time over the device-active span (first
+    event start → last event end). This replaces the round-1 per-step-sync
+    estimate, which on a tunneled runtime measured host round-trip latency
+    (~95 ms each), not device idleness; wall clock around the trace context
+    is also unusable because stopping the trace downloads the event buffer
+    through the (slow) tunnel.
 
-    def sync(x):
-        leaf = jax.tree.leaves(x)[0]
-        np.asarray(jax.device_get(leaf))  # a value fetch cannot lie
+    Returns NaN when no device trace is available (e.g. CPU backend).
+    """
+    import tempfile
+
+    import jax
 
     out = step_fn(carry, *args)
     carry = out[0]
-    sync(out[1:] if len(out) > 1 else out)
+    _scalar_sync(out[1] if len(out) > 1 else carry)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = step_fn(carry, *args)
-        carry = out[0]
-    sync(carry)
-    chain = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = step_fn(carry, *args)
-        carry = out[0]
-        sync(out[1] if len(out) > 1 else carry)
-    stepped = time.perf_counter() - t0
-    return min(chain / max(stepped, 1e-9), 1.0)
+    with tempfile.TemporaryDirectory() as td:
+        with jax.profiler.trace(td):
+            for _ in range(iters):
+                out = step_fn(carry, *args)
+                carry = out[0]
+            _scalar_sync(out[1] if len(out) > 1 else carry)
+        busy_span = trace_device_busy_s(td)
+    if busy_span is None:
+        return float("nan")
+    busy, span = busy_span
+    return min(busy / max(span, 1e-9), 1.0)
 
 
 class MetricsLogger:

@@ -2,6 +2,7 @@
 reference's time.time()-print-only story)."""
 
 import json
+import math
 import os
 
 import jax
@@ -56,4 +57,6 @@ def test_device_duty_cycle_chains_donated_state():
         return new, {"loss": new}
 
     duty = device_duty_cycle(step, jnp.zeros(()), jnp.ones(128), iters=5)
-    assert 0.0 < duty <= 1.0
+    # Trace-based measurement: on backends with no device track in the
+    # profiler trace (CPU), the documented result is NaN.
+    assert math.isnan(duty) or 0.0 < duty <= 1.0
