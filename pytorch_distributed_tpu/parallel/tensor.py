@@ -118,7 +118,8 @@ def opt_state_specs(params: Any, param_specs: Any, tx) -> Any:
     the parameter tree; each such leaf must shard exactly like its
     parameter. Leaves are matched by their tree-path suffix (optax state
     paths end with the full parameter path); anything else (schedule counts,
-    scalars) is replicated.
+    scalars) is replicated. Suffix matches are anchored at a path-component
+    boundary so e.g. 'proj/kernel' can never claim 'out_proj/kernel'.
     """
     flat_param_specs = {
         path_str(path): spec
@@ -129,7 +130,7 @@ def opt_state_specs(params: Any, param_specs: Any, tx) -> Any:
     def assign(path, leaf):
         name = path_str(path)
         for param_path, spec in flat_param_specs.items():
-            if name.endswith(param_path):
+            if name == param_path or name.endswith("/" + param_path):
                 return spec
         return P()
 

@@ -157,8 +157,10 @@ def shard_lm_state(
     nothing (every spec axis has size 1) and this is plain replication.
     ``config`` is required for MoE models (see ``lm_state_specs``) and is
     validated against the mesh: expert parallelism must span exactly the
-    data axis.
+    data axis, and a seq-sharded mesh requires ring attention.
     """
+    if config is not None:
+        check_seq_parallel_attention(mesh, config)
     if config is not None and config.ep_size > 1:
         if config.expert_axis != DATA_AXIS:
             raise ValueError(
@@ -180,11 +182,34 @@ def shard_lm_state(
     return jax.device_put(state, shardings), specs
 
 
+def check_seq_parallel_attention(mesh: Mesh, config, seq_axis: str = SEQ_AXIS):
+    """Refuse silently-wrong sequence parallelism.
+
+    Under a seq-sharded shard_map, dense/blockwise/flash attention computes
+    shard-LOCAL attention — each shard only attends to its own tokens — and
+    trains on wrong math without any error. Only 'ring' goes global. Raise
+    up front instead of producing a subtly broken model.
+    """
+    if (
+        seq_axis in mesh.shape
+        and mesh.shape[seq_axis] > 1
+        and getattr(config, "attention", None) != "ring"
+    ):
+        raise ValueError(
+            f"mesh shards the sequence axis {seq_axis!r} "
+            f"(size {mesh.shape[seq_axis]}) but config.attention="
+            f"{getattr(config, 'attention', None)!r}: non-ring attention is "
+            "shard-local under sequence parallelism and computes the wrong "
+            "function. Use attention='ring' (or a seq-axis size of 1)."
+        )
+
+
 def make_lm_train_step(
     mesh: Mesh,
     data_axis: str = DATA_AXIS,
     seq_axis: str = SEQ_AXIS,
     state_specs: Optional[TrainState] = None,
+    config=None,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -195,7 +220,12 @@ def make_lm_train_step(
     replicated. Gradients are psum'd over (data, seq) only — the model-axis
     collectives live inside the model via tp_copy/tp_reduce, which leave
     sharded-param grads local and replicated-param grads already complete.
+    ``config`` (the TransformerConfig), when given, is validated against the
+    mesh: a seq-sharded mesh requires ring attention
+    (``check_seq_parallel_attention``).
     """
+    if config is not None:
+        check_seq_parallel_attention(mesh, config, seq_axis)
     axes = (data_axis, seq_axis)
 
     def _local_step(state: TrainState, batch: dict):

@@ -63,10 +63,13 @@ class TrainerConfig:
     num_workers: int = 8
     prefetch: int = 2
     seed: int = 0
-    # multi-host suspend agreement: how often (steps) non-primary hosts learn
-    # of a primary-side suspend; 1 = every step (exact reference semantics,
-    # one tiny DCN broadcast per step), 0 = primary-only like the reference.
-    suspend_sync_every: int = 0
+    # multi-host suspend agreement: how often (steps) hosts agree on a
+    # suspend landing on ANY of them. 1 (default) = every step — a SIGTERM
+    # delivered to one host makes all hosts checkpoint and yield together
+    # (one tiny host-level collective per step, only when process_count>1;
+    # without it the survivors deadlock at their next collective).
+    # 0 = primary-only polling, the reference's exact (unsafe) semantics.
+    suspend_sync_every: int = 1
 
 
 class Trainer:
@@ -170,8 +173,15 @@ class Trainer:
     # ---- checkpoint contract (SURVEY.md §3.5) ----
 
     def _payload(self, epoch: int, step: int) -> dict:
+        """Checkpoint payload with every array gathered to host.
+
+        ``gather_global`` is a collective in multi-host runs, so this MUST
+        be called by every process together; only the subsequent disk write
+        is rank-0-gated (``restnet_ddp.py:36,145``)."""
+        from pytorch_distributed_tpu.utils.checkpoint import gather_global
+
         return {
-            "state": self.state,
+            "state": gather_global(self.state),
             "epoch": epoch,
             "step": step,
             "best_acc": self.best_acc,
@@ -209,8 +219,9 @@ class Trainer:
             )
         if not suspended:
             return
+        payload = self._payload(epoch, step + 1)  # collective: all ranks
         if jax.process_index() == 0:
-            self.ckpt.save_latest(self._payload(epoch, step + 1))
+            self.ckpt.save_latest(payload)
             rank0_print(f"suspend: saved {self.ckpt.latest_path} at epoch {epoch} step {step}")
         self.ckpt.wait()
         self.watcher.go_suspend()
@@ -303,8 +314,9 @@ class Trainer:
             )
             if summary["acc1"] > self.best_acc:
                 self.best_acc = summary["acc1"]
+                payload = self._payload(epoch + 1, 0)  # collective: all ranks
                 if jax.process_index() == 0:
-                    self.ckpt.save_best(self._payload(epoch + 1, 0))
+                    self.ckpt.save_best(payload)
                 rank0_print(f"new best acc1 {self.best_acc:.2f}, saved best.ckpt")
             epoch_s = time.time() - t0
             rank0_print(

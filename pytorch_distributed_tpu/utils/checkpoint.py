@@ -35,8 +35,60 @@ LATEST = "latest.ckpt"
 BEST = "best.ckpt"
 
 
+def gather_global(tree: Any) -> Any:
+    """Materialize every leaf as a host numpy array of the GLOBAL value.
+
+    Locally-readable leaves (fully addressable, or fully replicated across
+    hosts) are a straight ``device_get``. A leaf SHARDED across processes
+    (multi-host TP/EP/FSDP) is gathered with ``process_allgather`` — a
+    COLLECTIVE: every process in the job must call ``gather_global``
+    together, even ranks that will discard the result. The trainer
+    therefore builds checkpoint payloads on all ranks and gates only the
+    disk write on rank 0 (``restnet_ddp.py:36,145`` semantics). For plain
+    replicated DP (every reference mode) no collective runs and this is
+    exactly the old fast path.
+    """
+
+    def leaf_to_host(x):
+        if _needs_gather(x):
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(leaf_to_host, tree)
+
+
+def _needs_gather(x) -> bool:
+    """True for arrays whose global value is NOT locally readable: sharded
+    across processes and not replicated. Fully-replicated multi-host arrays
+    are readable from any single process (``device_get`` uses the local
+    copy), so plain multi-host DP never needs the collective."""
+    return (
+        isinstance(x, jax.Array)
+        and not x.is_fully_addressable
+        and not x.is_fully_replicated
+    )
+
+
 def _to_host(tree: Any) -> Any:
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    """Host-side snapshot for serialization. NOT a collective: leaves must
+    be locally readable (pass trees through ``gather_global`` first in
+    multi-host sharded runs — calling this from a rank-gated branch with
+    cross-process-sharded arrays would otherwise hang the job in a
+    one-sided collective)."""
+
+    def leaf_to_host(x):
+        if _needs_gather(x):
+            raise ValueError(
+                "checkpoint payload contains an array sharded across "
+                "processes; gather it on ALL processes with "
+                "utils.checkpoint.gather_global(tree) before the rank-0 "
+                "save call (process_allgather is a collective)."
+            )
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(leaf_to_host, tree)
 
 
 def save_checkpoint(path: str | os.PathLike, payload: Any) -> None:

@@ -80,3 +80,52 @@ def test_blockwise_lm_forward_matches_dense():
     out_d = model_d.apply(variables, tokens)
     out_b = model_b.apply(variables, tokens)
     np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_d), rtol=2e-4, atol=2e-5)
+
+
+def test_seq_sharded_mesh_rejects_non_ring_attention(devices8):
+    """ADVICE r1 (medium): dense/blockwise/flash under a seq-sharded
+    shard_map silently computes shard-local attention; the step builders
+    must refuse instead."""
+    from pytorch_distributed_tpu.train.lm import shard_lm_state
+
+    mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    cfg = tiny_config(attention="dense")
+    tx = sgd_with_weight_decay(0.1)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    with pytest.raises(ValueError, match="ring"):
+        shard_lm_state(mesh, state, cfg)
+    with pytest.raises(ValueError, match="ring"):
+        make_lm_train_step(mesh, config=cfg)
+    # ring on the same mesh is accepted
+    make_lm_train_step(mesh, config=tiny_config(attention="ring"))
+
+
+def test_opt_state_specs_suffix_match_is_component_anchored():
+    """ADVICE r1 (low): 'proj/kernel' must never claim 'out_proj/kernel'."""
+    import optax
+
+    from pytorch_distributed_tpu.parallel.tensor import opt_state_specs
+
+    params = {
+        "proj": {"kernel": jnp.zeros((4, 4))},
+        "out_proj": {"kernel": jnp.zeros((4, 4))},
+    }
+    param_specs = {
+        "proj": {"kernel": P("model", None)},
+        "out_proj": {"kernel": P(None, "model")},
+    }
+    tx = sgd_with_weight_decay(0.1, momentum=0.9)
+    specs = opt_state_specs(params, param_specs, tx)
+    momenta = [
+        (path, leaf)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+    ]
+    by_path = {
+        "".join(str(k) for k in path): leaf for path, leaf in momenta
+    }
+    proj = [s for p, s in by_path.items() if "proj" in p and "out_proj" not in p]
+    out_proj = [s for p, s in by_path.items() if "out_proj" in p]
+    assert proj and all(s == P("model", None) for s in proj), by_path
+    assert out_proj and all(s == P(None, "model") for s in out_proj), by_path

@@ -123,7 +123,7 @@ def test_expert_parallel_matches_single_device(devices8):
 
 def test_expert_weights_sharded_over_data(devices8):
     mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
-    cfg = tiny_config(n_experts=4, expert_axis="data", ep_size=4)
+    cfg = tiny_config(attention="ring", n_experts=4, expert_axis="data", ep_size=4)
     tx = sgd_with_weight_decay(0.1)
     state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
     state, specs = shard_lm_state(mesh, state, cfg)
@@ -148,7 +148,9 @@ def test_moe_replicated_experts_on_dp_mesh(devices8):
 
 def test_shard_lm_state_validates_ep(devices8):
     mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
-    cfg = tiny_config(n_experts=4, expert_axis="data", ep_size=2)  # != dp
+    cfg = tiny_config(
+        attention="ring", n_experts=4, expert_axis="data", ep_size=2
+    )  # ep_size != dp
     tx = sgd_with_weight_decay(0.1)
     state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
     with pytest.raises(ValueError, match="ep_size"):
