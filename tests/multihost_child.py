@@ -1,0 +1,127 @@
+"""Child process for the 2-process localhost rendezvous tests.
+
+Launched by tests/test_multihost.py with the reference's env contract
+(MASTER_IP/MASTER_PORT/WORLD_SIZE/RANK, ``restnet_ddp.py:87-94``) on the CPU
+backend with 4 virtual local devices per process → 8 global. Runs the real
+DDP code path: ``init_process_group`` → global ``make_mesh`` → ``Trainer``
+on synthetic data.
+
+Modes (argv[1]):
+  train    fit() a tiny run to completion, print a JSON result line with a
+           parameter digest so the parent can assert cross-host agreement.
+  suspend  train with many epochs and suspend_sync_every=1; the parent
+           SIGTERMs ONE rank mid-epoch and both processes must checkpoint
+           (rank 0) and yield together. Touches <save_dir>/started.<rank>
+           once training has begun so the parent knows when to fire.
+"""
+
+import json
+import os
+import sys
+
+# Backend setup must precede the jax import (see tests/conftest.py): the
+# axon plugin would otherwise claim the TPU tunnel from both processes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    mode = sys.argv[1]
+    save_dir = sys.argv[2]
+
+    from pytorch_distributed_tpu.data.synthetic import SyntheticImageClassification
+    from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.distributed import (
+        get_rank,
+        get_world_size,
+        init_process_group,
+        is_primary,
+    )
+    from pytorch_distributed_tpu.train import Trainer, TrainerConfig
+    from pytorch_distributed_tpu.utils.suspend import SuspendWatcher
+
+    init_process_group()
+    assert get_world_size() == 2, get_world_size()
+    assert jax.device_count() == 8, jax.device_count()
+    assert is_primary() == (get_rank() == 0)
+
+    model = ResNet(
+        stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=10, num_filters=8
+    )
+    epochs = 2 if mode == "train" else 50
+    cfg = TrainerConfig(
+        epochs=epochs,
+        batch_size=4,
+        lr=0.05,
+        save_dir=save_dir,
+        num_workers=0,
+        log_every=1,
+        suspend_sync_every=1,
+    )
+    train_ds = SyntheticImageClassification(size=64, image_size=16, num_classes=10)
+    val_ds = SyntheticImageClassification(size=16, image_size=16, num_classes=10, seed=1)
+
+    watcher = SuspendWatcher(install_handlers=(mode == "suspend"))
+    trainer = Trainer(
+        model,
+        train_ds,
+        val_ds,
+        cfg,
+        mesh=make_mesh(),
+        suspend_watcher=watcher,
+        input_shape=(1, 16, 16, 3),
+    )
+
+    if mode == "suspend":
+        # Signal readiness AFTER the first optimizer step has executed so the
+        # parent's SIGTERM lands mid-training, not mid-compile.
+        orig_epoch = trainer.train_epoch
+
+        def epoch_with_sentinel(epoch, start_step=0):
+            if epoch == trainer.start_epoch:
+                first = [True]
+
+                orig_suspend = trainer._maybe_suspend
+
+                def hooked(ep, st):
+                    if first[0]:
+                        first[0] = False
+                        with open(
+                            os.path.join(save_dir, f"started.{get_rank()}"), "w"
+                        ) as f:
+                            f.write("1")
+                    orig_suspend(ep, st)
+
+                trainer._maybe_suspend = hooked
+            return orig_epoch(epoch, start_step)
+
+        trainer.train_epoch = epoch_with_sentinel
+
+    summary = trainer.fit()
+    param_l1 = float(
+        sum(np.abs(np.asarray(jax.device_get(p))).sum()
+            for p in jax.tree.leaves(trainer.state.params))
+    )
+    print(json.dumps({
+        "rank": get_rank(),
+        "world": get_world_size(),
+        "resumed_from_step": trainer.start_epoch,
+        "val_loss": round(summary["loss"], 6),
+        "acc1": round(summary["acc1"], 4),
+        "best_acc": round(summary["best_acc"], 4),
+        "param_l1": param_l1,
+        "final_step": int(jax.device_get(trainer.state.step)),
+    }))
+
+
+if __name__ == "__main__":
+    main()
