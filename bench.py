@@ -139,6 +139,40 @@ def run(batch_size: int, tiny: bool, dtype=jnp.bfloat16, warmup: int = 8,
     return batch_size * iters / dt, dt / iters, duty
 
 
+def bench_data_pipeline(n: int = 2048) -> dict:
+    """Host input-pipeline throughput: the raw fast path (RawImageNet,
+    uint8, random-crop aug) through the real DataLoader. Measured per host
+    core so the number transfers to real pod hosts; scripts/bench_data.py
+    has the full per-stage breakdown (JPEG vs raw, reader, H2D)."""
+    import tempfile
+
+    from pytorch_distributed_tpu.data.loader import DataLoader
+    from pytorch_distributed_tpu.data.raw import RawImageNet, write_imagenet_raw_split
+
+    cache = os.path.join(tempfile.gettempdir(), f"pdt_bench_raw_{n}")
+    path = os.path.join(cache, "train.rawtprc")
+    if not os.path.exists(path):
+        os.makedirs(cache, exist_ok=True)
+        rng = np.random.default_rng(0)
+        write_imagenet_raw_split(
+            path,
+            ((rng.integers(0, 255, (256, 256, 3)).astype(np.uint8), i % 1000)
+             for i in range(n)),
+        )
+    workers = os.cpu_count() or 1
+    loader = DataLoader(RawImageNet("train", data_dir=cache, aug="crop"),
+                        batch_size=128, num_workers=workers, prefetch=4)
+    from pytorch_distributed_tpu.data.loader import measure_throughput
+
+    img_s = measure_throughput(loader, epochs=2)
+    return {
+        "data_pipeline_img_s": round(img_s, 1),
+        "data_pipeline_img_s_per_core": round(img_s / workers, 1),
+        "data_pipeline_mode": "raw_uint8_crop",
+        "host_cores": workers,
+    }
+
+
 def main() -> None:
     tiny = os.environ.get("BENCH_TINY", "") == "1"
     batch_size = int(os.environ.get("BENCH_BS", "64" if tiny else "128"))
@@ -167,6 +201,11 @@ def main() -> None:
     }
     if np.isfinite(duty):
         record["duty_cycle"] = round(duty, 4)
+    if not tiny and os.environ.get("BENCH_DATA", "1") == "1":
+        try:
+            record.update(bench_data_pipeline())
+        except Exception as e:
+            record["data_pipeline_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_FP32", "1") == "1":
         fp32_bs = batch_size
         while True:

@@ -2,6 +2,7 @@ from pytorch_distributed_tpu.data.sampler import DistributedSampler
 from pytorch_distributed_tpu.data.loader import DataLoader
 from pytorch_distributed_tpu.data.synthetic import SyntheticImageClassification
 from pytorch_distributed_tpu.data.imagenet import ImageNet
+from pytorch_distributed_tpu.data.raw import RawImageNet, write_imagenet_raw_split
 from pytorch_distributed_tpu.data.packed_record import (
     PackedRecordWriter,
     PackedRecordReader,
@@ -12,6 +13,8 @@ __all__ = [
     "DataLoader",
     "SyntheticImageClassification",
     "ImageNet",
+    "RawImageNet",
+    "write_imagenet_raw_split",
     "PackedRecordWriter",
     "PackedRecordReader",
 ]

@@ -64,6 +64,7 @@ class ImageNet:
         transform: Optional[Callable] = None,
         data_dir: str = DEFAULT_DATA_DIR,
         use_native: bool | None = None,
+        verify_crc: bool = False,
     ):
         self.split = split
         self.path = os.path.join(data_dir, f"{split}.tprc")
@@ -73,6 +74,10 @@ class ImageNet:
                 "pytorch_distributed_tpu.data.imagenet.write_imagenet_split()"
             )
         self.reader = PackedRecordReader(self.path, use_native=use_native)
+        # Per-read CRC costs ~3x read bandwidth (scripts/bench_data.py); the
+        # atomic TPRC writer cannot publish torn files, so the hot loop
+        # skips it by default. Opt in for integrity sweeps.
+        self.verify_crc = verify_crc
         if transform is None:
             transform = (
                 T.train_transform() if split == "train" else T.eval_transform()
@@ -95,7 +100,7 @@ class ImageNet:
     def getitem_rng(self, i: int, rng: np.random.Generator):
         """Deterministic-augmentation entry point: the loader derives ``rng``
         from (seed, epoch, index), so resumed runs see identical crops/flips."""
-        return self._decode(self.reader.read(int(i)), rng)
+        return self._decode(self.reader.read(int(i), self.verify_crc), rng)
 
     def __getitem__(self, i: int):
         return self.getitem_rng(i, np.random.default_rng())

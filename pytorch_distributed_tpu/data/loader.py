@@ -16,8 +16,11 @@ thread-pool design suited to TPU hosts:
   uninterrupted one;
 - bounded prefetch queue overlapping host data work with device steps.
 
-Batches are dicts of stacked numpy arrays: ``{"image": [B,H,W,C] f32,
-"label": [B] i32}``.
+Batches are dicts of stacked numpy arrays: ``{"image": [B,H,W,C], "label":
+[B] i32}``. Image dtype follows the dataset: float32 for host-normalized
+pipelines (``ImageNet`` transforms), **uint8** for the raw fast path
+(``data.raw.RawImageNet``) — uint8 batches are normalized on device by the
+compiled step (``train/step.prepare_image``).
 """
 
 from __future__ import annotations
@@ -33,7 +36,11 @@ from pytorch_distributed_tpu.data.sampler import DistributedSampler
 
 
 def _collate(samples) -> dict:
-    images = np.stack([s[0] for s in samples]).astype(np.float32)
+    images = np.stack([s[0] for s in samples])
+    if images.dtype != np.uint8:
+        # float pipelines collate to f32; uint8 (raw fast path) stays uint8 —
+        # 4x fewer H2D bytes, normalized on device (train/step.prepare_image)
+        images = images.astype(np.float32)
     labels = np.asarray([s[1] for s in samples], np.int32)
     return {"image": images, "label": labels}
 
@@ -147,3 +154,26 @@ class DataLoader:
 
     def __iter__(self) -> Iterator[dict]:
         return self.iter_batches(0)
+
+
+def measure_throughput(loader: DataLoader, epochs: int = 1) -> float:
+    """Unbiased items/s of a loader epoch.
+
+    Times COMPLETE fresh epochs: each ``iter_batches`` call starts with an
+    empty prefetch queue and its own worker pool, so consuming a whole epoch
+    measures production time end to end — no pre-filled batches inflate the
+    window (timing a partially-consumed iterator would count queued batches
+    as instantaneous). Used by bench.py's ``data_pipeline_img_s`` and
+    scripts/bench_data.py so the two report the same methodology.
+    """
+    import time
+
+    total = 0
+    t0 = time.perf_counter()
+    for _ in range(max(epochs, 1)):
+        for batch in loader.iter_batches(0):
+            total += len(batch["label"])
+    dt = time.perf_counter() - t0
+    if total == 0:
+        raise ValueError("loader produced no batches; nothing to measure")
+    return total / dt

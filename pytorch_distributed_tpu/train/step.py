@@ -42,6 +42,22 @@ from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, shard_map
 from pytorch_distributed_tpu.train.state import TrainState
 
 
+def prepare_image(image):
+    """Device-side normalization for uint8 batches (the raw fast path).
+
+    The raw input pipeline (``data.raw``) ships uint8 pixels — 4x fewer
+    host→device bytes — and this applies exactly the host ``Normalize``
+    math (``data/transforms.py``: /255, -mean, /std, fp32) inside the
+    compiled step, where it fuses into the stem conv. Float batches are
+    already normalized on host and pass through untouched.
+    """
+    if image.dtype != jnp.uint8:
+        return image
+    from pytorch_distributed_tpu.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+
+    return (image.astype(jnp.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+
+
 def make_train_step(
     mesh: Mesh,
     axis: str = DATA_AXIS,
@@ -61,7 +77,8 @@ def make_train_step(
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
             outputs, mutated = state.apply_fn(
-                variables, batch["image"], train=True, mutable=["batch_stats"]
+                variables, prepare_image(batch["image"]), train=True,
+                mutable=["batch_stats"],
             )
             loss = cross_entropy_loss(
                 outputs, batch["label"], label_smoothing=label_smoothing
@@ -159,7 +176,7 @@ def make_eval_step(
         variables = {"params": state.params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
-        logits = state.apply_fn(variables, batch["image"], train=False)
+        logits = state.apply_fn(variables, prepare_image(batch["image"]), train=False)
         batch_metrics = ClassificationMetrics.from_step(
             cross_entropy_loss(logits, batch["label"], reduction="sum"),
             logits,
