@@ -1,3 +1,8 @@
+from pytorch_distributed_tpu.parallel.fsdp import (
+    fsdp_param_specs,
+    fsdp_state_specs,
+    shard_fsdp_state,
+)
 from pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -31,6 +36,9 @@ from pytorch_distributed_tpu.parallel.collectives import (
 )
 
 __all__ = [
+    "fsdp_param_specs",
+    "fsdp_state_specs",
+    "shard_fsdp_state",
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",

@@ -120,6 +120,21 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def specs_to_shardings(mesh: Mesh, specs):
+    """PartitionSpec pytree → NamedSharding pytree over ``mesh``.
+
+    The one place the spec→sharding mapping lives: initial placement
+    (``fsdp.shard_fsdp_state``, ``lm.shard_lm_state``) and checkpoint
+    restore (``Trainer.try_resume``) must place identically or resumed runs
+    get a different layout than fresh ones.
+    """
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS):
     """Place a host-local numpy batch onto the mesh as a global array.
 

@@ -173,13 +173,10 @@ def shard_lm_state(
                 f"size {mesh.shape[DATA_AXIS]} (experts shard over the full "
                 "data axis)"
             )
+    from pytorch_distributed_tpu.parallel.mesh import specs_to_shardings
+
     specs = lm_state_specs(state, config=config)
-    shardings = jax.tree.map(
-        lambda s: jax.sharding.NamedSharding(mesh, s),
-        specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    return jax.device_put(state, shardings), specs
+    return jax.device_put(state, specs_to_shardings(mesh, specs)), specs
 
 
 def check_seq_parallel_attention(mesh: Mesh, config, seq_axis: str = SEQ_AXIS):

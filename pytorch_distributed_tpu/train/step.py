@@ -29,7 +29,7 @@ over the mesh's data axis and compiled once by ``jit``:
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +62,7 @@ def make_train_step(
     mesh: Mesh,
     axis: str = DATA_AXIS,
     label_smoothing: float = 0.0,
+    state_specs: Optional[TrainState] = None,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build the compiled training step for a mesh.
 
@@ -69,7 +70,19 @@ def make_train_step(
     global array dict sharded batch-dim over ``axis`` (see
     ``parallel.shard_batch``) and metrics are replicated scalars
     {loss, correct1, correct5, count, grads_finite}.
+
+    ``state_specs`` (from ``parallel.fsdp.shard_fsdp_state``) switches on
+    the FSDP/ZeRO-3 path: parameters and optimizer state live sharded over
+    ``axis``; the step all_gathers params before the forward and
+    psum_scatters gradients back to their owners — same math as replicated
+    DP (all_gather∘psum_scatter ≡ pmean), ~axis-size less state memory.
     """
+    fsdp = state_specs is not None
+    if fsdp:
+        from pytorch_distributed_tpu.parallel.fsdp import (
+            gather_params,
+            scatter_grads,
+        )
 
     def _local_step(state: TrainState, batch: dict):
         def loss_fn(params):
@@ -85,11 +98,20 @@ def make_train_step(
             )
             return state.scaler.scale_loss(loss), (loss, outputs, mutated)
 
-        grads, (loss, logits, mutated) = jax.grad(loss_fn, has_aux=True)(state.params)
+        full_params = (
+            gather_params(state.params, state_specs.params, axis)
+            if fsdp
+            else state.params
+        )
+        grads, (loss, logits, mutated) = jax.grad(loss_fn, has_aux=True)(full_params)
         grads = state.scaler.unscale_grads(grads)
         # The DP gradient combine: per-replica mean-loss grads averaged over
         # the axis ≙ DDP's allreduce-and-divide (restnet_ddp.py:29 via D7).
-        grads = jax.lax.pmean(grads, axis_name=axis)
+        # FSDP: the same mean, delivered shard-wise (reduce-scatter).
+        if fsdp:
+            grads = scatter_grads(grads, state_specs.params, axis)
+        else:
+            grads = jax.lax.pmean(grads, axis_name=axis)
 
         new_batch_stats = mutated.get("batch_stats", state.batch_stats)
         if new_batch_stats:
@@ -107,7 +129,12 @@ def make_train_step(
             # GradScaler contract (resnet_ddp_apex.py:30-33): on non-finite
             # grads skip the whole update (params, momentum, schedule count)
             # and back off the scale — computed on device, no host sync.
-            finite = all_finite(grads)
+            # The flag must be GLOBAL: under FSDP each device only sees its
+            # gradient shards, so a local inf would make devices disagree on
+            # skipping and silently diverge params/opt/scaler state.
+            finite = (
+                jax.lax.pmin(all_finite(grads).astype(jnp.int32), axis) > 0
+            )
             updates, new_opt_state = state.tx.update(
                 grads, state.opt_state, state.params
             )
@@ -147,20 +174,20 @@ def make_train_step(
         }
         return new_state, metrics
 
-    state_specs = P()
-    batch_specs = P(axis)
+    state_spec = state_specs if fsdp else P()
+    metrics_spec = P()
     sharded = shard_map(
         _local_step,
         mesh=mesh,
-        in_specs=(state_specs, batch_specs),
-        out_specs=(state_specs, state_specs),
+        in_specs=(state_spec, P(axis)),
+        out_specs=(state_spec, metrics_spec),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
 
 def make_eval_step(
-    mesh: Mesh, axis: str = DATA_AXIS
+    mesh: Mesh, axis: str = DATA_AXIS, state_specs: Optional[TrainState] = None
 ) -> Callable[[TrainState, dict, ClassificationMetrics], ClassificationMetrics]:
     """Build the compiled validation step (ref ``validate``,
     ``restnet_ddp.py:50-61``).
@@ -172,8 +199,17 @@ def make_eval_step(
     reference's reduce-to-rank-0 (``restnet_ddp.py:63-64``).
     """
 
+    fsdp = state_specs is not None
+    if fsdp:
+        from pytorch_distributed_tpu.parallel.fsdp import gather_params
+
     def _local_eval(state: TrainState, batch: dict, metrics: ClassificationMetrics):
-        variables = {"params": state.params}
+        params = (
+            gather_params(state.params, state_specs.params, axis)
+            if fsdp
+            else state.params
+        )
+        variables = {"params": params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
         logits = state.apply_fn(variables, prepare_image(batch["image"]), train=False)
@@ -187,7 +223,7 @@ def make_eval_step(
     sharded = shard_map(
         _local_eval,
         mesh=mesh,
-        in_specs=(P(), P(axis), P()),
+        in_specs=(state_specs if fsdp else P(), P(axis), P()),
         out_specs=P(),
         check_vma=False,
     )

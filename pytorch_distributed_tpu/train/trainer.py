@@ -70,6 +70,9 @@ class TrainerConfig:
     # without it the survivors deadlock at their next collective).
     # 0 = primary-only polling, the reference's exact (unsafe) semantics.
     suspend_sync_every: int = 1
+    # FSDP/ZeRO-3: shard params+optimizer over the data axis (~axis-size
+    # less state memory; identical training math — parallel/fsdp.py).
+    fsdp: bool = False
 
 
 class Trainer:
@@ -148,15 +151,24 @@ class Trainer:
         state = TrainState.create(
             model, tx, jax.random.key(config.seed), input_shape, scaler=scaler
         )
-        # Replicated placement ≙ DDP's broadcast-from-rank-0 (restnet_ddp.py:99).
-        self.state = jax.device_put(
-            state, mesh_lib.replicated_sharding(self.mesh)
-        )
+        if config.fsdp:
+            from pytorch_distributed_tpu.parallel.fsdp import shard_fsdp_state
+
+            self.state, self.state_specs = shard_fsdp_state(self.mesh, state)
+        else:
+            # Replicated placement ≙ DDP's broadcast-from-rank-0
+            # (restnet_ddp.py:99).
+            self.state = jax.device_put(
+                state, mesh_lib.replicated_sharding(self.mesh)
+            )
+            self.state_specs = None
 
         self.train_step = make_train_step(
-            self.mesh, label_smoothing=config.label_smoothing
+            self.mesh,
+            label_smoothing=config.label_smoothing,
+            state_specs=self.state_specs,
         )
-        self.eval_step = make_eval_step(self.mesh)
+        self.eval_step = make_eval_step(self.mesh, state_specs=self.state_specs)
 
         self.best_acc = 0.0
         self.start_epoch = 0
@@ -192,9 +204,15 @@ class Trainer:
         if not self.ckpt.has_latest():
             return False
         restored = self.ckpt.load_latest(self._payload(0, 0))
-        self.state = jax.device_put(
-            restored["state"], mesh_lib.replicated_sharding(self.mesh)
-        )
+        if self.state_specs is not None:
+            self.state = jax.device_put(
+                restored["state"],
+                mesh_lib.specs_to_shardings(self.mesh, self.state_specs),
+            )
+        else:
+            self.state = jax.device_put(
+                restored["state"], mesh_lib.replicated_sharding(self.mesh)
+            )
         self.start_epoch = int(restored["epoch"])
         self.start_step = int(restored["step"])
         self.best_acc = float(restored["best_acc"])

@@ -1,0 +1,205 @@
+"""FSDP/ZeRO-3 over the data axis: parity with replicated DP, the memory
+win, and checkpoint interchange (SURVEY.md §2c's last open row)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
+from pytorch_distributed_tpu.parallel import (
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+    shard_fsdp_state,
+)
+from pytorch_distributed_tpu.parallel.fsdp import fsdp_dim, fsdp_param_specs
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.step import make_eval_step, make_train_step
+
+
+def tiny_model():
+    return ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=10,
+                  num_filters=16)
+
+
+def make_state(mesh):
+    tx = sgd_with_weight_decay(0.1, momentum=0.9, weight_decay=1e-4)
+    return TrainState.create(tiny_model(), tx, jax.random.key(0), (1, 16, 16, 3))
+
+
+def batch_for(mesh, n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return shard_batch(mesh, {
+        "image": rng.normal(size=(n, 16, 16, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, n).astype(np.int32),
+    })
+
+
+def test_fsdp_dim_selection():
+    assert fsdp_dim((4096, 128), 8) == 0        # largest divisible dim
+    assert fsdp_dim((127, 4096), 8) == 1        # only dim 1 divisible
+    assert fsdp_dim((63,), 8) is None           # tiny -> replicate
+    assert fsdp_dim((1031, 1031), 8) is None    # nothing divisible
+    assert fsdp_dim((), 8) is None              # scalar
+
+
+def test_fsdp_specs_and_memory_win(devices8):
+    mesh = make_mesh(devices8)
+    state = make_state(mesh)
+    sharded, specs = shard_fsdp_state(mesh, state)
+    param_specs = fsdp_param_specs(state.params, mesh)
+    # at least the conv kernels and fc weights must actually shard
+    sharded_leaves = [s for s in jax.tree.leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P)) if s != P()]
+    assert len(sharded_leaves) >= 4
+    # tiny leaves (fc kernel here is 32x10) stay replicated by threshold
+    assert param_specs["fc"]["kernel"] == P()
+    # exact memory win on the largest leaf: its sharded dim is 1/8 per device
+    flat = dict(
+        (str(p), (v, s))
+        for (p, v), (_, s) in zip(
+            jax.tree_util.tree_leaves_with_path(sharded.params),
+            jax.tree_util.tree_leaves_with_path(
+                param_specs, is_leaf=lambda x: isinstance(x, P)
+            ),
+        )
+    )
+    path, (leaf, spec) = max(flat.items(), key=lambda kv: kv[1][0].size)
+    d = next(i for i, part in enumerate(spec) if part is not None)
+    expect = tuple(
+        n // 8 if i == d else n for i, n in enumerate(leaf.shape)
+    )
+    assert {s.data.shape for s in leaf.addressable_shards} == {expect}, path
+    # the total addressable state is ~1/8 of a replicated run's per-device
+    # copy for sharded leaves (each device holds exactly one shard)
+    for s in leaf.addressable_shards:
+        assert s.data.size == leaf.size // 8
+    # momentum trace shards identically to its param
+    mom_match = [
+        m for m in jax.tree.leaves(sharded.opt_state)
+        if isinstance(m, jax.Array) and m.shape == leaf.shape
+        and {s.data.shape for s in m.addressable_shards} == {expect}
+    ]
+    assert mom_match
+
+
+def test_fsdp_training_matches_replicated_dp(devices8):
+    mesh = make_mesh(devices8)
+
+    def run(fsdp, steps=4):
+        state = make_state(mesh)
+        if fsdp:
+            state, specs = shard_fsdp_state(mesh, state)
+        else:
+            state = jax.device_put(state, replicated_sharding(mesh))
+            specs = None
+        step = make_train_step(mesh, state_specs=specs)
+        losses = []
+        for i in range(steps):
+            state, metrics = step(state, batch_for(mesh, seed=i))
+            losses.append(float(metrics["loss"]))
+        return state, losses
+
+    state_f, losses_f = run(True)
+    state_r, losses_r = run(False)
+    np.testing.assert_allclose(losses_f, losses_r, rtol=1e-5)
+    flat_r = {str(p): v for p, v in
+              jax.tree_util.tree_leaves_with_path(state_r.params)}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state_f.params):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_r[str(path)]),
+            rtol=1e-4, atol=1e-6, err_msg=str(path),
+        )
+
+
+def test_fsdp_eval_matches_replicated(devices8):
+    from pytorch_distributed_tpu.ops.metrics import ClassificationMetrics
+
+    mesh = make_mesh(devices8)
+    state = make_state(mesh)
+    state_r = jax.device_put(state, replicated_sharding(mesh))
+    state_f, specs = shard_fsdp_state(mesh, state)
+    batch = batch_for(mesh, seed=3)
+    empty = lambda: jax.device_put(ClassificationMetrics.empty(),
+                                   replicated_sharding(mesh))
+    m_r = make_eval_step(mesh)(state_r, batch, empty())
+    m_f = make_eval_step(mesh, state_specs=specs)(state_f, batch, empty())
+    r, f = jax.device_get(m_r).summary(), jax.device_get(m_f).summary()
+    assert r["acc1"] == f["acc1"] and r["loss"] == pytest.approx(f["loss"], rel=1e-6)
+
+
+def test_fsdp_trainer_end_to_end_with_resume(tmp_path, devices8):
+    """Trainer(fsdp=True): trains, checkpoints (canonical global layout),
+    and a REPLICATED run restores the FSDP checkpoint — the one-canonical-
+    layout contract across parallelism modes."""
+    from pytorch_distributed_tpu.data.synthetic import SyntheticImageClassification
+    from pytorch_distributed_tpu.train import Trainer, TrainerConfig
+
+    mesh = make_mesh(devices8)
+    save = os.fspath(tmp_path / "fsdp_out")
+    cfg = TrainerConfig(epochs=1, batch_size=2, lr=0.05, save_dir=save,
+                        num_workers=0, fsdp=True)
+    train_ds = SyntheticImageClassification(size=64, image_size=16, num_classes=10)
+    val_ds = SyntheticImageClassification(size=16, image_size=16, num_classes=10,
+                                          seed=1)
+    tr = Trainer(tiny_model(), train_ds, val_ds, cfg, mesh=mesh,
+                 input_shape=(1, 16, 16, 3))
+    res = tr.fit()
+    assert os.path.exists(os.path.join(save, "best.ckpt"))
+
+    # restore the FSDP-written best checkpoint into a replicated trainer
+    cfg2 = TrainerConfig(epochs=1, batch_size=2, save_dir=save, num_workers=0,
+                         fsdp=False)
+    tr2 = Trainer(tiny_model(), train_ds, val_ds, cfg2, mesh=mesh,
+                  input_shape=(1, 16, 16, 3))
+    restored = tr2.ckpt.load_best(tr2._payload(0, 0))
+    flat_f = {str(p): v for p, v in
+              jax.tree_util.tree_leaves_with_path(tr.state.params)}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+        restored["state"].params
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_f[str(path)]), rtol=1e-6,
+            err_msg=str(path),
+        )
+
+
+def test_fsdp_fp16_scaler_parity_with_replicated(devices8):
+    """The GradScaler finite gate must be GLOBAL under FSDP (a local inf in
+    one device's shard must skip the step on every device): fp16 FSDP
+    training tracks fp16 replicated training exactly, scaler state
+    included."""
+    from pytorch_distributed_tpu.ops.precision import DynamicLossScaler
+
+    mesh = make_mesh(devices8)
+    tx = sgd_with_weight_decay(0.1, momentum=0.9)
+
+    def run(fsdp, steps=3):
+        state = TrainState.create(
+            tiny_model(), tx, jax.random.key(0), (1, 16, 16, 3),
+            scaler=DynamicLossScaler.create(init_scale=2.0**8),
+        )
+        if fsdp:
+            state, specs = shard_fsdp_state(mesh, state)
+        else:
+            state = jax.device_put(state, replicated_sharding(mesh))
+            specs = None
+        step = make_train_step(mesh, state_specs=specs)
+        out = []
+        for i in range(steps):
+            state, metrics = step(state, batch_for(mesh, seed=i))
+            out.append((float(metrics["loss"]), float(metrics["grads_finite"])))
+        return state, out
+
+    state_f, hist_f = run(True)
+    state_r, hist_r = run(False)
+    np.testing.assert_allclose(hist_f, hist_r, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(state_f.scaler.scale)),
+        np.asarray(jax.device_get(state_r.scaler.scale)),
+    )
