@@ -3,6 +3,7 @@ from pytorch_distributed_tpu.data.loader import DataLoader
 from pytorch_distributed_tpu.data.synthetic import SyntheticImageClassification
 from pytorch_distributed_tpu.data.imagenet import ImageNet
 from pytorch_distributed_tpu.data.raw import RawImageNet, write_imagenet_raw_split
+from pytorch_distributed_tpu.data.tokens import SyntheticTokens, TokenArrayDataset
 from pytorch_distributed_tpu.data.packed_record import (
     PackedRecordWriter,
     PackedRecordReader,
@@ -15,6 +16,8 @@ __all__ = [
     "ImageNet",
     "RawImageNet",
     "write_imagenet_raw_split",
+    "SyntheticTokens",
+    "TokenArrayDataset",
     "PackedRecordWriter",
     "PackedRecordReader",
 ]

@@ -55,6 +55,7 @@ class DataLoader:
         drop_last: bool = True,
         prefetch: int = 2,
         seed: int = 0,
+        collate_fn=None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -65,6 +66,9 @@ class DataLoader:
         self.drop_last = drop_last
         self.prefetch = max(prefetch, 1)
         self.seed = seed
+        # default: image-classification (image, label) stacking; LM loaders
+        # pass train.lm_trainer.lm_collate
+        self.collate_fn = collate_fn or _collate
 
     def __len__(self) -> int:
         n = len(self.sampler)
@@ -97,7 +101,7 @@ class DataLoader:
             samples = list(pool.map(self._getitem, ints))
         else:
             samples = [self._getitem(i) for i in ints]
-        return _collate(samples)
+        return self.collate_fn(samples)
 
     def iter_batches(self, start_batch: int = 0) -> Iterator[dict]:
         """Iterate batches of the current epoch, optionally seeking past the

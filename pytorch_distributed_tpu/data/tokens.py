@@ -1,0 +1,70 @@
+"""Token-sequence datasets for LM training.
+
+Same sample protocol as the image datasets (``__len__`` /
+``getitem_rng(i, rng)`` → sample) so ``DistributedSampler`` + ``DataLoader``
+drive LM training with the exact epoch/shard/seek semantics the image
+trainer has (torch-parity sampler, seekable resume).
+
+A sample is one fixed-length token sequence ``[L] int32``; the LM trainer
+builds labels/weights via ``train.lm.shift_labels`` at collate time.
+
+- ``TokenArrayDataset``: windows over one flat token array (memmap-friendly
+  — the standard packed-corpus layout).
+- ``SyntheticTokens``: deterministic per-index random sequences for
+  tests/benchmarks (same index ⇒ same sequence, like
+  ``SyntheticImageClassification``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class TokenArrayDataset:
+    """Non-overlapping ``seq_len`` windows over a flat token array.
+
+    ``tokens`` may be any 1-D integer array-like, including ``np.memmap``
+    over a packed corpus file; nothing is copied until a window is read.
+    """
+
+    def __init__(self, tokens, seq_len: int):
+        self.tokens = tokens
+        self.seq_len = int(seq_len)
+        if self.seq_len < 1:
+            raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+        self._n = len(tokens) // self.seq_len
+        if self._n == 0:
+            raise ValueError(
+                f"token array ({len(tokens)}) shorter than seq_len {seq_len}"
+            )
+
+    def __len__(self) -> int:
+        return self._n
+
+    def getitem_rng(self, i: int, rng=None):
+        lo = int(i) * self.seq_len
+        return np.asarray(self.tokens[lo : lo + self.seq_len], np.int32)
+
+    def __getitem__(self, i: int):
+        return self.getitem_rng(i)
+
+
+class SyntheticTokens:
+    """Deterministic fake token sequences (seeded per index)."""
+
+    def __init__(self, size: int, seq_len: int, vocab_size: int, seed: int = 0):
+        self.size = int(size)
+        self.seq_len = int(seq_len)
+        self.vocab_size = int(vocab_size)
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.size
+
+    def getitem_rng(self, i: int, rng=None):
+        r = np.random.default_rng([self.seed, int(i)])
+        # token 0 is reserved as the pad/ignore id by shift_labels
+        return r.integers(1, self.vocab_size, self.seq_len).astype(np.int32)
+
+    def __getitem__(self, i: int):
+        return self.getitem_rng(i)

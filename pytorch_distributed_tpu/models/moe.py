@@ -38,40 +38,64 @@ import jax
 import jax.numpy as jnp
 
 
-def top1_dispatch(
+def topk_dispatch(
     router_logits: jax.Array,  # [T, E] fp32
     capacity: int,
+    k: int = 1,
 ):
-    """Static-shape top-1 routing.
+    """Static-shape top-k routing (k=1: Switch; k=2: GShard).
 
-    Returns (dispatch [T, E, C] f32 0/1, combine [T, E, C] f32 gate-weighted,
-    aux_loss scalar). Tokens beyond an expert's capacity are dropped
-    (all-zero rows in dispatch ⇒ the layer contributes nothing for them).
+    Returns (dispatch [T, E, C] f32 0/1, combine [T, E, C] f32
+    gate-weighted, aux_loss scalar, stats dict). Capacity is filled in
+    choice-rank priority (all first choices place before any second
+    choice, the GShard rule); assignments beyond capacity are dropped —
+    ``stats["dropped_frac"]`` is the fraction of tokens with NO surviving
+    route (their block output is the residual alone).
+
+    Gates: k=1 uses the raw chosen probability (Switch); k>1 normalizes the
+    chosen probabilities to sum to 1 (GShard), keeping the layer's output
+    scale constant in k.
     """
     t, e = router_logits.shape
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
+    topv, topi = jax.lax.top_k(probs, k)  # [T, k]
+    if k == 1:
+        gates = topv
+    else:
+        gates = topv / jnp.maximum(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
 
-    # Position of each token within its chosen expert's buffer (0-based);
-    # non-chosen entries contribute 0, so the row-sum is exactly the
-    # chosen-expert position.
-    position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E]
-    pos_tok = jnp.sum(position, axis=-1).astype(jnp.int32)  # [T]
-    keep_tok = (pos_tok < capacity).astype(jnp.float32)  # [T]
-    dispatch = (
-        onehot[:, :, None]
-        * jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)[:, None, :]
-        * keep_tok[:, None, None]
-    )  # [T, E, C]
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)  # buffer fill from earlier ranks
+    for r in range(k):  # k is a small static constant
+        onehot = jax.nn.one_hot(topi[:, r], e, dtype=jnp.float32)  # [T, E]
+        # Position of each token within its expert's buffer: tokens placed
+        # by earlier choice-ranks (counts) go first, then arrival order.
+        position = (jnp.cumsum(onehot, axis=0) - 1.0 + counts) * onehot
+        pos_tok = jnp.sum(position, axis=-1).astype(jnp.int32)  # [T]
+        keep = (pos_tok < capacity).astype(jnp.float32)  # [T]
+        disp_r = (
+            onehot[:, :, None]
+            * jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None]
+        )  # [T, E, C]
+        dispatch = dispatch + disp_r
+        combine = combine + disp_r * gates[:, r][:, None, None]
+        counts = counts + jnp.sum(onehot * keep[:, None], axis=0)
 
-    gate = jnp.sum(probs * onehot, axis=-1)  # [T] chosen-expert prob
-    combine = dispatch * gate[:, None, None]
+    # Switch/GShard load-balancing loss on FIRST-choice statistics:
+    # E · Σ_e (token fraction)·(mean prob).
+    first = jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.sum(jnp.mean(first, axis=0) * jnp.mean(probs, axis=0))
+    routed = jnp.sum(dispatch, axis=(1, 2))  # [T] surviving routes per token
+    stats = {"dropped_frac": jnp.mean((routed == 0.0).astype(jnp.float32))}
+    return dispatch, combine, aux, stats
 
-    # Switch load-balancing loss: E · Σ_e (token fraction)·(mean prob).
-    frac = jnp.mean(onehot, axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(frac * mean_prob)
+
+def top1_dispatch(router_logits: jax.Array, capacity: int):
+    """Switch-style top-1 routing (back-compat wrapper over
+    ``topk_dispatch``): returns (dispatch, combine, aux_loss)."""
+    dispatch, combine, aux, _ = topk_dispatch(router_logits, capacity, k=1)
     return dispatch, combine, aux
 
 
@@ -88,6 +112,7 @@ class MoEMLP(nn.Module):
     mlp_dim: int
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    top_k: int = 1
     ep_size: int = 1
     expert_axis: Optional[str] = None
     dtype: jnp.dtype = jnp.float32
@@ -102,9 +127,15 @@ class MoEMLP(nn.Module):
 
         router = nn.Dense(e, use_bias=False, dtype=jnp.float32, name="router")
         logits = router(x_flat.astype(jnp.float32))
-        capacity = max(math.ceil(self.capacity_factor * t / e), 1)
-        dispatch, combine, aux = top1_dispatch(logits, capacity)
+        capacity = max(math.ceil(self.capacity_factor * self.top_k * t / e), 1)
+        dispatch, combine, aux, stats = topk_dispatch(
+            logits, capacity, k=self.top_k
+        )
         self.sow("aux_loss", "moe", self.aux_loss_weight * aux)
+        # Observability: capacity drops are otherwise silent (a dropped
+        # token's block output is just the residual). The LM step reports
+        # the mean over layers/shards as metrics["moe_dropped_frac"].
+        self.sow("moe_stats", "dropped_frac", stats["dropped_frac"])
 
         w_up = self.param(
             "w_up",

@@ -61,6 +61,7 @@ class TransformerConfig:
     moe_every: int = 2
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    moe_top_k: int = 1  # 1 = Switch, 2 = GShard top-2
     expert_axis: Optional[str] = None
     ep_size: int = 1
 
@@ -68,6 +69,11 @@ class TransformerConfig:
         if self.n_experts and self.n_experts % self.ep_size:
             raise ValueError(
                 f"n_experts {self.n_experts} not divisible by ep_size {self.ep_size}"
+            )
+        if self.n_experts and not 1 <= self.moe_top_k <= self.n_experts:
+            raise ValueError(
+                f"moe_top_k {self.moe_top_k} must be in [1, n_experts="
+                f"{self.n_experts}]"
             )
         if self.embed_dim % self.num_heads:
             raise ValueError(
@@ -82,15 +88,13 @@ class TransformerConfig:
                 f"mlp width {self.embed_dim * self.mlp_ratio} not divisible "
                 f"by tp_size {self.tp_size}"
             )
-        if self.dropout:
-            raise NotImplementedError(
-                "dropout is not implemented yet; set dropout=0.0 (a silently "
-                "ignored regularization knob would be worse than an error)"
-            )
+        if not 0.0 <= self.dropout < 1.0:
+            raise ValueError(f"dropout must be in [0, 1), got {self.dropout}")
 
 
 class Attention(nn.Module):
     config: TransformerConfig
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, x, position_offset):
@@ -146,32 +150,45 @@ class Attention(nn.Module):
             from pytorch_distributed_tpu.parallel.tensor import tp_reduce
 
             out = tp_reduce(out, cfg.model_axis)
+        # Residual dropout AFTER tp_reduce: activations here are replicated
+        # across the model axis, and the step derives the dropout rng from
+        # (seed, step, data/seq coords) only — model-axis replicas see the
+        # same mask and stay bitwise identical (train/lm.py rng plumbing).
+        if cfg.dropout:
+            out = nn.Dropout(cfg.dropout, deterministic=self.deterministic)(out)
         return out
 
 
 class Block(nn.Module):
     config: TransformerConfig
     use_moe: bool = False
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, x, position_offset):
         cfg = self.config
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + Attention(cfg, name="attn")(h, position_offset)
+        x = x + Attention(cfg, deterministic=self.deterministic, name="attn")(
+            h, position_offset
+        )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.use_moe:
             from pytorch_distributed_tpu.models.moe import MoEMLP
 
-            return x + MoEMLP(
+            out = MoEMLP(
                 n_experts=cfg.n_experts,
                 mlp_dim=cfg.embed_dim * cfg.mlp_ratio,
                 capacity_factor=cfg.capacity_factor,
                 aux_loss_weight=cfg.moe_aux_weight,
+                top_k=cfg.moe_top_k,
                 ep_size=cfg.ep_size,
                 expert_axis=cfg.expert_axis,
                 dtype=cfg.dtype,
                 name="moe",
             )(h)
+            if cfg.dropout:  # residual dropout, same placement as dense MLP
+                out = nn.Dropout(cfg.dropout, deterministic=self.deterministic)(out)
+            return x + out
         if cfg.model_axis:
             from pytorch_distributed_tpu.parallel.tensor import tp_copy, tp_reduce
 
@@ -185,6 +202,8 @@ class Block(nn.Module):
         h = nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype, name="mlp_down")(h)
         if cfg.model_axis:
             h = tp_reduce(h, cfg.model_axis)
+        if cfg.dropout:  # after tp_reduce — see Attention
+            h = nn.Dropout(cfg.dropout, deterministic=self.deterministic)(h)
         return x + h
 
 
@@ -201,13 +220,21 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, position_offset: jax.Array | int = 0, train: bool = True):
         cfg = self.config
-        del train  # dropout-free for now; signature parity with ResNet
+        # Dropout is active only when train=True AND an rng is provided
+        # (apply(..., rngs={"dropout": key}) — train/lm.py derives the key
+        # from (seed, step, shard coords) so resumed runs are bit-identical).
+        deterministic = not (train and cfg.dropout > 0.0)
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype, name="wte")(tokens)
         pos = position_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype, name="wpe")(pos)
+        if cfg.dropout:
+            x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
         for i in range(cfg.num_layers):
             use_moe = bool(cfg.n_experts) and (i % cfg.moe_every == cfg.moe_every - 1)
-            x = Block(cfg, use_moe=use_moe, name=f"block{i}")(x, position_offset)
+            x = Block(
+                cfg, use_moe=use_moe, deterministic=deterministic,
+                name=f"block{i}",
+            )(x, position_offset)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -2,8 +2,16 @@ from pytorch_distributed_tpu.train.state import TrainState
 from pytorch_distributed_tpu.train.step import make_eval_step, make_train_step
 from pytorch_distributed_tpu.train.lm import (
     create_lm_state,
+    make_lm_eval_step,
     make_lm_train_step,
+    shard_lm_state,
     shift_labels,
+)
+from pytorch_distributed_tpu.train.lm_trainer import (
+    LMTrainer,
+    LMTrainerConfig,
+    lm_collate,
+    shard_lm_batch,
 )
 from pytorch_distributed_tpu.train.trainer import Trainer, TrainerConfig
 
@@ -12,8 +20,14 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "create_lm_state",
+    "make_lm_eval_step",
     "make_lm_train_step",
+    "shard_lm_state",
     "shift_labels",
+    "LMTrainer",
+    "LMTrainerConfig",
+    "lm_collate",
+    "shard_lm_batch",
     "Trainer",
     "TrainerConfig",
 ]

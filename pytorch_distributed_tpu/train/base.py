@@ -1,0 +1,105 @@
+"""Shared trainer machinery: the suspend/checkpoint/resume contract.
+
+One home for the logic both trainers (image ``Trainer``, ``LMTrainer``)
+must agree on — the reference's §3.5 fault-tolerance path plus this
+framework's multi-host hardening. Keeping it in one place is load-bearing:
+these are collective-ordering-sensitive code paths where two diverging
+copies would deadlock pods.
+
+Subclass contract:
+  - ``self.config`` has ``suspend_sync_every``; ``self.watcher`` is a
+    SuspendWatcher; ``self.ckpt`` a Checkpointer; ``self.mesh`` the mesh;
+    ``self.state`` the TrainState; ``self.state_specs`` a spec tree or None.
+  - ``_extra_payload()`` → dict of host-side scalars to checkpoint
+    (best_acc / best_ppl, ...); ``_restore_extra(dict)`` applies them.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from pytorch_distributed_tpu.parallel import collectives, mesh as mesh_lib
+from pytorch_distributed_tpu.utils.logging import rank0_print
+
+
+class SuspendableTrainer:
+    """Mixin implementing suspend agreement, payloads, and resume."""
+
+    # ---- checkpoint payloads (collective: call on ALL ranks) ----
+
+    def _extra_payload(self) -> dict:
+        return {}
+
+    def _restore_extra(self, restored: dict) -> None:
+        pass
+
+    def _payload(self, epoch: int, step: int) -> dict:
+        """Checkpoint payload with every array gathered to host.
+
+        ``gather_global`` is a collective for cross-process-sharded states,
+        so this MUST run on every process together; only the disk write is
+        rank-0-gated (``restnet_ddp.py:36,145``)."""
+        from pytorch_distributed_tpu.utils.checkpoint import gather_global
+
+        payload = {"state": gather_global(self.state), "epoch": epoch,
+                   "step": step}
+        payload.update(self._extra_payload())
+        return payload
+
+    def try_resume(self) -> bool:
+        """Restore from ``latest.ckpt`` if present (``restnet_ddp.py:127-132``)."""
+        if not self.ckpt.has_latest():
+            return False
+        restored = self.ckpt.load_latest(self._payload(0, 0))
+        if self.state_specs is not None:
+            self.state = jax.device_put(
+                restored["state"],
+                mesh_lib.specs_to_shardings(self.mesh, self.state_specs),
+            )
+        else:
+            self.state = jax.device_put(
+                restored["state"], mesh_lib.replicated_sharding(self.mesh)
+            )
+        self.start_epoch = int(restored["epoch"])
+        self.start_step = int(restored["step"])
+        self._restore_extra(restored)
+        rank0_print(
+            f"resumed from {self.ckpt.latest_path}: "
+            f"epoch {self.start_epoch} step {self.start_step}"
+        )
+        return True
+
+    # ---- the suspend agreement (ref restnet_ddp.py:36-47) ----
+
+    def _maybe_suspend(self, epoch: int, step: int) -> None:
+        """Poll → agree → checkpoint → yield.
+
+        Multi-host with ``suspend_sync_every=N``: a locally-latched signal
+        is ONLY acted on at agreement steps (step % N == 0), where every
+        host all-reduces its flag — acting immediately on a local signal
+        would send one host into the collective payload gather while the
+        others run the next train step (mismatched collectives, permanent
+        hang). The watcher latches, so deferring loses nothing.
+        ``suspend_sync_every=0`` keeps the reference's primary-only
+        semantics (unsafe by design, documented).
+        """
+        suspended = self.watcher.receive_suspend_command()
+        sync = self.config.suspend_sync_every
+        if sync and jax.process_count() > 1:
+            if step % sync != 0:
+                return  # defer to the next agreement step
+            suspended = bool(
+                collectives.all_reduce(np.float32(suspended), "max")
+            )
+        if not suspended:
+            return
+        payload = self._payload(epoch, step + 1)  # collective: all ranks
+        if jax.process_index() == 0:
+            self.ckpt.save_latest(payload)
+            rank0_print(
+                f"suspend: saved {self.ckpt.latest_path} at epoch {epoch} "
+                f"step {step}"
+            )
+        self.ckpt.wait()
+        self.watcher.go_suspend()

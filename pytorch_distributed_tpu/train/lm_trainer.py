@@ -1,0 +1,261 @@
+"""LMTrainer: the full training loop for language models.
+
+Round-1 built the compiled LM step (``train/lm.py``) but no loop around it
+(VERDICT missing #8): no epochs, no eval, no checkpoint/suspend for LMs.
+This is the LM counterpart of ``train.Trainer`` — same reference-derived
+contracts (epoch loop + ``set_epoch`` reshuffle, seekable mid-epoch step
+resume, suspend→checkpoint→yield with the multi-host any-reduce agreement,
+latest/best artifacts, JSONL metrics; ``restnet_ddp.py:19-47,127-150``) —
+over a (data, seq, model) mesh with TP/EP/SP-sharded or replicated state:
+
+- state placement and gradient reduction follow ``shard_lm_state``'s spec
+  tree; checkpoints store the canonical GLOBAL layout via
+  ``checkpoint.gather_global`` (all-ranks collective, rank-0 write), so a
+  dp×sp×tp checkpoint restores onto any other mesh shape;
+- validation reports token perplexity (``make_lm_eval_step``: global
+  psum'd loss-sum/token-count, dropout off);
+- best.ckpt tracks LOWEST validation perplexity (the LM analog of the
+  reference's best-accuracy tracking, ``restnet_ddp.py:145-150``);
+- dropout is deterministic under resume: masks derive from
+  (seed, state.step, shard coords), never from wall clock.
+
+Batch layout: the loader yields host-local ``{"tokens","labels","weights"}``
+[B_local, L]; ``shard_lm_batch`` places them P(data, seq) as global arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.optim import build_optimizer
+from pytorch_distributed_tpu.ops.schedules import warmup_cosine
+from pytorch_distributed_tpu.parallel import mesh as mesh_lib
+from pytorch_distributed_tpu.train.base import SuspendableTrainer
+from pytorch_distributed_tpu.train.lm import (
+    create_lm_state,
+    empty_lm_metrics,
+    make_lm_eval_step,
+    make_lm_train_step,
+    shard_lm_state,
+    shift_labels,
+)
+from pytorch_distributed_tpu.utils.checkpoint import Checkpointer
+from pytorch_distributed_tpu.utils.logging import rank0_print
+from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+from pytorch_distributed_tpu.utils.suspend import NullSuspendWatcher, SuspendWatcher
+
+
+def lm_collate(samples) -> dict:
+    """[L]-token samples → {"tokens", "labels", "weights"} [B, L]."""
+    tokens = np.stack(samples).astype(np.int32)
+    labels, weights = shift_labels(tokens)
+    return {"tokens": tokens, "labels": labels, "weights": weights}
+
+
+def shard_lm_batch(mesh, batch, data_axis=mesh_lib.DATA_AXIS,
+                   seq_axis=mesh_lib.SEQ_AXIS):
+    """Host-local [B, L] arrays → global arrays sharded P(data, seq)."""
+    sharding = NamedSharding(mesh, P(data_axis, seq_axis))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)
+        ),
+        batch,
+    )
+
+
+@dataclasses.dataclass
+class LMTrainerConfig:
+    epochs: int = 1
+    batch_size: int = 8  # sequences per data-replica step
+    lr: float = 3e-4
+    weight_decay: float = 0.1
+    warmup_steps: int = 0
+    min_lr_ratio: float = 0.1
+    optimizer: str = "adamw"
+    save_dir: str = "output_lm"
+    log_every: int = 100
+    num_workers: int = 0
+    prefetch: int = 2
+    seed: int = 0
+    suspend_sync_every: int = 1  # see TrainerConfig.suspend_sync_every
+
+
+class LMTrainer(SuspendableTrainer):
+    """Drives (TransformerConfig, token datasets) over a mesh."""
+
+    def __init__(
+        self,
+        model_config,
+        train_dataset,
+        val_dataset,
+        config: LMTrainerConfig,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        suspend_watcher: Optional[SuspendWatcher] = None,
+    ):
+        from pytorch_distributed_tpu.data import DataLoader, DistributedSampler
+
+        self.config = config
+        self.model_config = model_config
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        self.watcher = suspend_watcher or NullSuspendWatcher()
+        self.ckpt = Checkpointer(config.save_dir)
+
+        n_local = mesh_lib.local_replica_count(self.mesh)
+        local_batch = config.batch_size * n_local
+        self.train_sampler = DistributedSampler(
+            len(train_dataset), num_replicas=jax.process_count(),
+            rank=jax.process_index(), shuffle=True, seed=config.seed,
+        )
+        self.val_sampler = DistributedSampler(
+            len(val_dataset), num_replicas=jax.process_count(),
+            rank=jax.process_index(), shuffle=False, seed=config.seed,
+        )
+        self.train_loader = DataLoader(
+            train_dataset, batch_size=local_batch, sampler=self.train_sampler,
+            num_workers=config.num_workers, drop_last=True,
+            prefetch=config.prefetch, seed=config.seed, collate_fn=lm_collate,
+        )
+        self.val_loader = DataLoader(
+            val_dataset, batch_size=local_batch, sampler=self.val_sampler,
+            num_workers=config.num_workers, drop_last=False,
+            prefetch=config.prefetch, seed=config.seed, collate_fn=lm_collate,
+        )
+        self._local_batch = local_batch
+
+        steps_per_epoch = len(self.train_loader)
+        schedule = warmup_cosine(
+            config.lr,
+            total_steps=max(steps_per_epoch * config.epochs, 1),
+            warmup_steps=config.warmup_steps,
+            final_lr=config.lr * config.min_lr_ratio,
+        )
+        tx = build_optimizer(
+            config.optimizer, schedule, weight_decay=config.weight_decay
+        )
+        state = create_lm_state(model_config, tx, jax.random.key(config.seed))
+        self.state, self.state_specs = shard_lm_state(
+            self.mesh, state, model_config
+        )
+        self.train_step = make_lm_train_step(
+            self.mesh, state_specs=self.state_specs, config=model_config,
+            dropout_seed=config.seed,
+        )
+        self.eval_step = make_lm_eval_step(
+            self.mesh, state_specs=self.state_specs, config=model_config
+        )
+
+        self.best_ppl = float("inf")
+        self.start_epoch = 0
+        self.start_step = 0
+        self.metrics_log = MetricsLogger(
+            os.path.join(config.save_dir, "metrics.jsonl")
+            if jax.process_index() == 0
+            else None
+        )
+
+    # ---- checkpoint contract: shared machinery in train/base.py ----
+
+    def _extra_payload(self) -> dict:
+        return {"best_ppl": self.best_ppl}
+
+    def _restore_extra(self, restored: dict) -> None:
+        self.best_ppl = float(restored["best_ppl"])
+
+    # ---- loops ----
+
+    def train_epoch(self, epoch: int, start_step: int = 0) -> dict:
+        cfg = self.config
+        last: dict = {}
+        t0 = time.perf_counter()
+        steps_done = 0
+        tokens_per_step = None
+        for step, host_batch in enumerate(
+            self.train_loader.iter_batches(start_step), start=start_step
+        ):
+            batch = shard_lm_batch(self.mesh, host_batch)
+            self.state, metrics = self.train_step(self.state, batch)
+            steps_done += 1
+            if cfg.log_every and step % cfg.log_every == 0:
+                last = {k: float(v) for k, v in metrics.items()}
+                tokens_per_step = last["tokens"]
+                rank0_print(
+                    f"epoch {epoch} step {step}: loss {last['loss']:.4f}"
+                )
+                self.metrics_log.log(kind="train", epoch=epoch, step=step,
+                                     **last)
+            self._maybe_suspend(epoch, step)
+        if steps_done:
+            float(self.state.step)  # drain async dispatch before the clock
+            elapsed = time.perf_counter() - t0
+            record = {
+                "kind": "epoch_timing", "epoch": epoch, "steps": steps_done,
+                "mean_ms": 1e3 * elapsed / steps_done,
+            }
+            if tokens_per_step:
+                record["tokens_per_s"] = tokens_per_step * steps_done / elapsed
+            self.metrics_log.log(**record)
+        return last
+
+    def validate(self) -> dict:
+        acc = jax.device_put(
+            empty_lm_metrics(), mesh_lib.replicated_sharding(self.mesh)
+        )
+        for host_batch in self.val_loader.iter_batches(0):
+            n = host_batch["tokens"].shape[0]
+            pad = self._local_batch - n
+            if pad:
+                # zero-weight padding rows keep the compiled batch shape
+                # (one program, no recompiles) and contribute no loss/tokens
+                host_batch = {
+                    k: np.concatenate(
+                        [v, np.zeros((pad,) + v.shape[1:], v.dtype)]
+                    )
+                    for k, v in host_batch.items()
+                }
+            acc = self.eval_step(
+                self.state, shard_lm_batch(self.mesh, host_batch), acc
+            )
+        acc = jax.device_get(acc)
+        tokens = float(acc["tokens"])
+        if tokens == 0.0:
+            raise ValueError(
+                "validation saw zero tokens — the val dataset is smaller "
+                "than one global batch on every host; shrink batch_size or "
+                "grow the val split"
+            )
+        mean = float(acc["loss_sum"]) / tokens
+        return {"loss": mean, "ppl": float(np.exp(min(mean, 30.0))),
+                "tokens": tokens}
+
+    def fit(self) -> dict:
+        self.try_resume()
+        summary: dict = {}
+        for epoch in range(self.start_epoch, self.config.epochs):
+            t0 = time.time()
+            self.train_sampler.set_epoch(epoch)
+            start_step = self.start_step if epoch == self.start_epoch else 0
+            self.train_epoch(epoch, start_step)
+            summary = self.validate()
+            rank0_print(
+                f"epoch {epoch}: val loss {summary['loss']:.4f} "
+                f"ppl {summary['ppl']:.3f}"
+            )
+            if summary["ppl"] < self.best_ppl:
+                self.best_ppl = summary["ppl"]
+                payload = self._payload(epoch + 1, 0)  # collective
+                if jax.process_index() == 0:
+                    self.ckpt.save_best(payload)
+                rank0_print(f"new best ppl {self.best_ppl:.3f}, saved best.ckpt")
+            self.metrics_log.log(kind="val", epoch=epoch,
+                                 epoch_s=time.time() - t0, **summary)
+        self.start_step = 0
+        summary["best_ppl"] = self.best_ppl
+        return summary

@@ -35,7 +35,8 @@ from pytorch_distributed_tpu.ops.metrics import ClassificationMetrics
 from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
 from pytorch_distributed_tpu.ops.precision import DynamicLossScaler, NoOpLossScaler
 from pytorch_distributed_tpu.ops.schedules import step_lr
-from pytorch_distributed_tpu.parallel import collectives, mesh as mesh_lib
+from pytorch_distributed_tpu.parallel import mesh as mesh_lib
+from pytorch_distributed_tpu.train.base import SuspendableTrainer
 from pytorch_distributed_tpu.train.state import TrainState
 from pytorch_distributed_tpu.train.step import make_eval_step, make_train_step
 from pytorch_distributed_tpu.utils.checkpoint import Checkpointer
@@ -75,7 +76,7 @@ class TrainerConfig:
     fsdp: bool = False
 
 
-class Trainer:
+class Trainer(SuspendableTrainer):
     """Drives (model, datasets) over a mesh with the config's recipe."""
 
     def __init__(
@@ -182,67 +183,16 @@ class Trainer:
             else None
         )
 
-    # ---- checkpoint contract (SURVEY.md §3.5) ----
+    # ---- checkpoint contract (SURVEY.md §3.5): shared machinery in
+    # train/base.py (payload gather, resume placement, suspend agreement);
+    # the payload reads the trainer's LIVE best_acc, fixing the reference's
+    # stale-best_acc bug (SURVEY.md §2a defects). ----
 
-    def _payload(self, epoch: int, step: int) -> dict:
-        """Checkpoint payload with every array gathered to host.
+    def _extra_payload(self) -> dict:
+        return {"best_acc": self.best_acc}
 
-        ``gather_global`` is a collective in multi-host runs, so this MUST
-        be called by every process together; only the subsequent disk write
-        is rank-0-gated (``restnet_ddp.py:36,145``)."""
-        from pytorch_distributed_tpu.utils.checkpoint import gather_global
-
-        return {
-            "state": gather_global(self.state),
-            "epoch": epoch,
-            "step": step,
-            "best_acc": self.best_acc,
-        }
-
-    def try_resume(self) -> bool:
-        """Restore from ``latest.ckpt`` if present (ref ``restnet_ddp.py:127-132``)."""
-        if not self.ckpt.has_latest():
-            return False
-        restored = self.ckpt.load_latest(self._payload(0, 0))
-        if self.state_specs is not None:
-            self.state = jax.device_put(
-                restored["state"],
-                mesh_lib.specs_to_shardings(self.mesh, self.state_specs),
-            )
-        else:
-            self.state = jax.device_put(
-                restored["state"], mesh_lib.replicated_sharding(self.mesh)
-            )
-        self.start_epoch = int(restored["epoch"])
-        self.start_step = int(restored["step"])
+    def _restore_extra(self, restored: dict) -> None:
         self.best_acc = float(restored["best_acc"])
-        rank0_print(
-            f"resumed from {self.ckpt.latest_path}: "
-            f"epoch {self.start_epoch} step {self.start_step} best_acc {self.best_acc:.2f}"
-        )
-        return True
-
-    def _maybe_suspend(self, epoch: int, step: int) -> None:
-        """Poll → checkpoint → yield (ref ``restnet_ddp.py:36-47``). Fixes the
-        reference's stale-best_acc bug (SURVEY.md §2a defects): the payload
-        reads the trainer's live best_acc, not an epoch-start copy."""
-        suspended = self.watcher.receive_suspend_command()
-        sync = self.config.suspend_sync_every
-        if sync and jax.process_count() > 1 and step % sync == 0:
-            # Any-reduce, not primary-broadcast: a preemption signal landing
-            # on any single host must make every host checkpoint and yield
-            # together, or the survivors deadlock in the next collective.
-            suspended = bool(
-                collectives.all_reduce(np.float32(suspended), "max")
-            )
-        if not suspended:
-            return
-        payload = self._payload(epoch, step + 1)  # collective: all ranks
-        if jax.process_index() == 0:
-            self.ckpt.save_latest(payload)
-            rank0_print(f"suspend: saved {self.ckpt.latest_path} at epoch {epoch} step {step}")
-        self.ckpt.wait()
-        self.watcher.go_suspend()
 
     # ---- the loops ----
 

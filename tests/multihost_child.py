@@ -65,7 +65,7 @@ def main() -> None:
         save_dir=save_dir,
         num_workers=0,
         log_every=1,
-        suspend_sync_every=1,
+        suspend_sync_every=int(os.environ.get("SUSPEND_SYNC", "1")),
     )
     train_ds = SyntheticImageClassification(size=64, image_size=16, num_classes=10)
     val_ds = SyntheticImageClassification(size=16, image_size=16, num_classes=10, seed=1)

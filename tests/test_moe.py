@@ -182,3 +182,99 @@ def test_moe_aux_loss_trains(devices8):
         first = first if first is not None else loss
         last = loss
     assert np.isfinite(last) and last < first
+
+def test_top2_dispatch_math():
+    from pytorch_distributed_tpu.models.moe import topk_dispatch
+
+    rng = np.random.default_rng(5)
+    logits = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+    dispatch, combine, aux, stats = topk_dispatch(logits, capacity=16, k=2)
+    d = np.asarray(dispatch)
+    # ample capacity: every token gets exactly 2 routes
+    np.testing.assert_allclose(d.sum(axis=(1, 2)), 2.0)
+    assert float(stats["dropped_frac"]) == 0.0
+    # combine weights are the top-2 probs normalized to sum 1 per token
+    c = np.asarray(combine)
+    np.testing.assert_allclose(c.sum(axis=(1, 2)), 1.0, rtol=1e-5)
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    top2 = np.sort(probs, axis=-1)[:, -2:]
+    np.testing.assert_allclose(
+        c.max(axis=(1, 2)), top2.max(-1) / top2.sum(-1), rtol=1e-5
+    )
+    assert float(aux) > 0
+    # per-slot exclusivity and capacity still hold
+    assert (d.sum(axis=0) <= 1.0 + 1e-6).all()
+
+
+def test_top2_rank_priority_under_capacity():
+    from pytorch_distributed_tpu.models.moe import topk_dispatch
+
+    # 3 tokens all prefer expert 0 then expert 1; capacity 2: first choices
+    # fill expert 0 with tokens 0,1; second choices fill expert 1 with
+    # tokens 0,1 (rank priority + arrival order); token 2 gets NOTHING and
+    # is the dropped fraction the new metric reports.
+    logits = jnp.asarray(np.tile([4.0, 2.0, -4.0], (3, 1)), jnp.float32)
+    dispatch, _, _, stats = topk_dispatch(logits, capacity=2, k=2)
+    d = np.asarray(dispatch)
+    assert d[:2, 0].sum() == 2.0  # expert 0 at capacity, first choices win
+    assert d[:2, 1].sum() == 2.0  # their second choices fill expert 1
+    assert d[2].sum() == 0.0  # token 2 fully dropped
+    np.testing.assert_allclose(float(stats["dropped_frac"]), 1.0 / 3.0,
+                               rtol=1e-6)
+
+
+def test_moe_top2_ep_parity_and_dropped_metric(devices8):
+    """top-2 routing under expert parallelism matches single-device, and
+    the step reports moe_dropped_frac."""
+    mesh_ep = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    mesh_1 = make_mesh(devices8[:1])
+
+    def run(mesh, ep):
+        cfg = tiny_config(
+            attention="ring" if mesh.shape["seq"] > 1 else "dense",
+            n_experts=4, moe_every=2, moe_top_k=2,
+            capacity_factor=float(4 * 8), moe_aux_weight=0.0,
+            expert_axis="data" if ep > 1 else None, ep_size=ep,
+        )
+        tx = sgd_with_weight_decay(0.1, momentum=0.9)
+        state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+        state, specs = shard_lm_state(mesh, state, cfg)
+        step_fn = make_lm_train_step(mesh, state_specs=specs, config=cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(1, 128, (4, 32)).astype(np.int32)
+        labels, weights = shift_labels(tokens)
+        sh = NamedSharding(mesh, P("data", "seq"))
+        batch = {"tokens": jax.device_put(tokens, sh),
+                 "labels": jax.device_put(labels, sh),
+                 "weights": jax.device_put(weights, sh)}
+        losses, dropped = [], []
+        for _ in range(3):
+            state, m = step_fn(state, batch)
+            losses.append(float(m["loss"]))
+            dropped.append(float(m["moe_dropped_frac"]))
+        return losses, dropped
+
+    losses_ep, dropped_ep = run(mesh_ep, ep=4)
+    losses_1, dropped_1 = run(mesh_1, ep=1)
+    np.testing.assert_allclose(losses_ep, losses_1, rtol=5e-4)
+    # huge capacity factor -> nothing dropped, metric present and zero
+    assert dropped_ep == dropped_1 == [0.0, 0.0, 0.0]
+
+
+def test_moe_dropped_frac_nonzero_when_capacity_tight(devices8):
+    mesh = make_mesh(devices8[:1])
+    cfg = tiny_config(n_experts=4, moe_every=2, capacity_factor=0.3,
+                      moe_aux_weight=0.0)
+    tx = sgd_with_weight_decay(0.1)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    state, specs = shard_lm_state(mesh, state, cfg)
+    step_fn = make_lm_train_step(mesh, state_specs=specs, config=cfg)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(1, 128, (4, 32)).astype(np.int32)
+    labels, weights = shift_labels(tokens)
+    sh = NamedSharding(mesh, P("data", "seq"))
+    batch = {"tokens": jax.device_put(tokens, sh),
+             "labels": jax.device_put(labels, sh),
+             "weights": jax.device_put(weights, sh)}
+    _, m = step_fn(state, batch)
+    assert 0.0 < float(m["moe_dropped_frac"]) < 1.0

@@ -34,7 +34,8 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def launch(rank: int, port: int, mode: str, save_dir: str) -> subprocess.Popen:
+def launch(rank: int, port: int, mode: str, save_dir: str,
+           extra_env=None) -> subprocess.Popen:
     env = {
         k: v
         for k, v in os.environ.items()
@@ -48,6 +49,7 @@ def launch(rank: int, port: int, mode: str, save_dir: str) -> subprocess.Popen:
         WORLD_SIZE="2",
         RANK=str(rank),
     )
+    env.update(extra_env or {})
     return subprocess.Popen(
         [sys.executable, CHILD, mode, save_dir],
         env=env,
@@ -147,3 +149,35 @@ def test_multihost_suspend_agreement_and_resume(tmp_path):
     assert any("resumed from" in o for o in outs), outs
     r0, r1 = (result_line(o) for o in outs)
     assert r0["param_l1"] == r1["param_l1"]
+
+
+def test_suspend_sync_gt_one_defers_without_deadlock(tmp_path):
+    """suspend_sync_every=3: a SIGTERM landing at a non-agreement step must
+    be DEFERRED (latched) to the next agreement step, not acted on locally
+    — acting locally sends one host into the collective checkpoint gather
+    while the other runs the next train step (permanent hang). Regression
+    for the r2 code-review finding."""
+    port = free_port()
+    save = os.fspath(tmp_path / "sync3")
+    os.makedirs(save, exist_ok=True)
+    procs = [
+        launch(r, port, "suspend", save, extra_env={"SUSPEND_SYNC": "3"})
+        for r in (0, 1)
+    ]
+    deadline = time.monotonic() + 420
+    sentinels = [os.path.join(save, f"started.{r}") for r in (0, 1)]
+    while time.monotonic() < deadline:
+        if all(os.path.exists(s) for s in sentinels):
+            break
+        if any(p.poll() is not None for p in procs):
+            raise AssertionError(f"child died early: {communicate(procs, 5)}")
+        time.sleep(0.5)
+    else:
+        for p in procs:
+            p.kill()
+        raise AssertionError("children never reached the training loop")
+    procs[1].send_signal(signal.SIGTERM)
+    results = communicate(procs, timeout=300)  # would time out on deadlock
+    for rc, out, err in results:
+        assert rc == 0, f"rc={rc}\nstdout:{out}\nstderr:{err}"
+    assert os.path.exists(os.path.join(save, "latest.ckpt"))
