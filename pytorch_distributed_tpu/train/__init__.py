@@ -13,6 +13,11 @@ from pytorch_distributed_tpu.train.lm_trainer import (
     lm_collate,
     shard_lm_batch,
 )
+from pytorch_distributed_tpu.train.pp import (
+    create_pp_lm_state,
+    make_pp_lm_train_step,
+    shard_pp_state,
+)
 from pytorch_distributed_tpu.train.trainer import Trainer, TrainerConfig
 
 __all__ = [
@@ -28,6 +33,9 @@ __all__ = [
     "LMTrainerConfig",
     "lm_collate",
     "shard_lm_batch",
+    "create_pp_lm_state",
+    "make_pp_lm_train_step",
+    "shard_pp_state",
     "Trainer",
     "TrainerConfig",
 ]

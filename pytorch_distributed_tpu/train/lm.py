@@ -345,15 +345,33 @@ def make_lm_eval_step(
     per-token loss sum and token count are psum'd over (data, seq) so every
     shard (and host) carries the global totals — the reference's
     reduce-to-0 superset, same as the image eval step.
+
+    MoE configs evaluate DROPLESS (capacity_factor raised to n_experts so
+    no token ever hits a full expert): under tight train-time capacity, the
+    routing a token gets depends on which other rows share its batch —
+    zero-weight padding rows could displace real tokens' routes and make
+    reported perplexity vary with the val-set padding. Dropless eval is
+    deterministic per token and standard practice.
     """
     if config is not None:
         check_seq_parallel_attention(mesh, config, seq_axis)
     axes = (data_axis, seq_axis)
+    eval_apply = None
+    if config is not None and getattr(config, "n_experts", 0):
+        import dataclasses
+
+        from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+        eval_cfg = dataclasses.replace(
+            config, capacity_factor=float(config.n_experts)
+        )
+        eval_apply = TransformerLM(eval_cfg).apply
 
     def _local_eval(state: TrainState, batch: dict, acc: dict):
         lq = batch["tokens"].shape[1]
         offset = jax.lax.axis_index(seq_axis) * lq
-        logits = state.apply_fn(
+        apply_fn = eval_apply if eval_apply is not None else state.apply_fn
+        logits = apply_fn(
             {"params": state.params},
             batch["tokens"],
             position_offset=offset,

@@ -30,3 +30,16 @@ def devices8():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
     return devices[:8]
+
+
+def assert_trees_equal(a, b, rtol=0, atol=0):
+    """Leaf-wise comparison of two pytrees by path (shared test helper)."""
+    import numpy as np
+
+    flat_b = {str(p): v for p, v in jax.tree_util.tree_leaves_with_path(b)}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(a):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(leaf)),
+            np.asarray(jax.device_get(flat_b[str(path)])),
+            rtol=rtol, atol=atol, err_msg=str(path),
+        )

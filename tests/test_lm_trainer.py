@@ -45,14 +45,7 @@ def make_lm_trainer(save_dir, devices8, watcher=None, **cfg_over):
                      suspend_watcher=watcher)
 
 
-def params_equal(a, b, rtol=0, atol=0):
-    flat_b = {str(p): v for p, v in jax.tree_util.tree_leaves_with_path(b)}
-    for path, leaf in jax.tree_util.tree_leaves_with_path(a):
-        np.testing.assert_allclose(
-            np.asarray(jax.device_get(leaf)),
-            np.asarray(jax.device_get(flat_b[str(path)])),
-            rtol=rtol, atol=atol, err_msg=str(path),
-        )
+from conftest import assert_trees_equal as params_equal  # noqa: E402
 
 
 def test_token_array_dataset_windows():
