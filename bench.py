@@ -139,6 +139,34 @@ def run(batch_size: int, tiny: bool, dtype=jnp.bfloat16, warmup: int = 8,
     return batch_size * iters / dt, dt / iters, duty
 
 
+def bench_flash_attention(l: int = 2048) -> dict:
+    """Pallas flash fwd+bwd vs XLA blockwise at one LM-shaped config
+    (causal, B2 H4 D128) — the headline kernel comparison; the full sweep
+    incl. dense and more lengths lives in scripts/bench_attention.py."""
+    import functools
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "scripts"))
+    import bench_attention as ba
+
+    from pytorch_distributed_tpu.ops.attention import blockwise_attention
+    from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+    b, h, d = 2, 4, 128
+    out = {}
+    for name, fn in (
+        ("flash", functools.partial(flash_attention, causal=True)),
+        ("blockwise", functools.partial(blockwise_attention, causal=True,
+                                        block_size=512)),
+    ):
+        _, tflops = ba.bench_impl(name, fn, b, h, l, d, True, "fwdbwd",
+                                  quiet=True)
+        out[f"attn_{name}_fwdbwd_tflops"] = tflops
+    out["attn_len"] = l
+    return out
+
+
 def bench_data_pipeline(n: int = 2048) -> dict:
     """Host input-pipeline throughput: the raw fast path (RawImageNet,
     uint8, random-crop aug) through the real DataLoader. Measured per host
@@ -201,6 +229,11 @@ def main() -> None:
     }
     if np.isfinite(duty):
         record["duty_cycle"] = round(duty, 4)
+    if not tiny and os.environ.get("BENCH_ATTN", "1") == "1":
+        try:
+            record.update(bench_flash_attention())
+        except Exception as e:
+            record["flash_attn_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_DATA", "1") == "1":
         try:
             record.update(bench_data_pipeline())

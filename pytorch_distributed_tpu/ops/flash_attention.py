@@ -1,9 +1,10 @@
-"""FlashAttention forward as a Pallas TPU kernel.
+"""FlashAttention forward AND backward as Pallas TPU kernels.
 
 The blockwise kernel (``ops.attention.blockwise_attention``) is the XLA-fused
 reference; this is the hand-tiled fast path for the same math, built per the
 TPU Pallas playbook (/opt/skills/guides/pallas_guide.md):
 
+Forward (``_fwd_kernel``):
 - grid (B·H, Lq/block_q, Lk/block_k), KV innermost and sequential
   ("arbitrary" dimension semantics — it carries the online-softmax
   recurrence); Q/K/V blocks staged HBM→VMEM by BlockSpec index maps;
@@ -11,12 +12,25 @@ TPU Pallas playbook (/opt/skills/guides/pallas_guide.md):
   KV sweep for each Q block; everything accumulates in fp32 while inputs can
   be bf16 feeding the MXU (``preferred_element_type=f32``);
 - causal masking skips fully-masked KV blocks with ``pl.when`` (no FLOPs
-  spent above the diagonal — the compute saving the plain ring schedule
-  lacks) and applies a multiplicative mask so fully-masked rows yield zeros
-  (same contract as ``attend_block``);
-- backward differentiates the blockwise jnp path via ``jax.custom_vjp``
-  (rematerialized, O(L·block) memory) — a hand-written Pallas backward is
-  the natural next step, the seam is already in place.
+  spent above the diagonal) and applies a multiplicative mask so
+  fully-masked rows yield zeros;
+- alongside O it emits the row logsumexp (LSE), which is what makes the
+  one-pass backward possible.
+
+Backward (FlashAttention-2 decomposition, two kernels — round-2, replacing
+the rematerialized blockwise VJP):
+  with P = exp(S - LSE),  Δ_i = Σ_j P_ij (dO V^T)_ij = rowsum(dO ⊙ O):
+    dV = P^T dO
+    dS = P ⊙ (dO V^T − Δ)·scale
+    dQ = dS K          (``_bwd_dq_kernel``: per-Q-block, sweeps KV)
+    dK = dS^T Q        (``_bwd_dkv_kernel``: per-KV-block, sweeps Q)
+  Δ is one fused XLA elementwise pass outside the kernels; no O(L²) tensor
+  ever exists in HBM and nothing is rematerialized through the slow path.
+
+Arbitrary lengths: inputs are zero-padded to block multiples and the
+kernels mask padded KEY positions explicitly (padded query rows compute
+garbage that is sliced away), so any (Lq, Lk) works — the round-1
+multiple-of-block restriction is gone.
 
 Shapes follow the framework convention ``[B, L, H, D]``.
 """
@@ -35,12 +49,12 @@ from jax.experimental.pallas import tpu as pltpu
 # flash_attention lazily, so environments without pallas keep every other
 # attention path working and fail loudly only when flash is actually chosen.
 
-from pytorch_distributed_tpu.ops.attention import NEG_INF, blockwise_attention
+from pytorch_distributed_tpu.ops.attention import NEG_INF
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
 ):
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -64,21 +78,21 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < kv_len  # padded keys contribute nothing
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            mask = k_pos <= q_pos
-            s = jnp.where(mask, s, NEG_INF)
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]  # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)  # [block_q, block_k]
-        if causal:
-            p = p * mask  # fully-masked rows stay all-zero (l == 0 → out 0)
+        p = p * mask  # fully-masked rows stay all-zero (l == 0 → out 0)
         corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
@@ -97,20 +111,24 @@ def _fwd_kernel(
 
     @pl.when(ki == n_k - 1)
     def _finalize():
-        denom = jnp.maximum(l_scr[:, :1], 1e-37)
-        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:, :1], 1e-37)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # LSE = m + log l; fully-masked rows get a huge negative (their
+        # backward P = exp(s - lse) must still be ~0, not inf).
+        lse = jnp.where(
+            l_scr[:, :1] > 0.0, m_scr[:, :1] + jnp.log(l), NEG_INF
+        )
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
-def _flash_fwd(
-    q3, k3, v3, scale, causal, block_q, block_k, interpret
-):
-    """[BH, L, D] inputs → [BH, Lq, D]."""
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret):
+    """[BH, L, D] inputs → ([BH, Lq, D] out, [BH, Lq, 128] lse)."""
     bh, lq, d = q3.shape
     lk = k3.shape[1]
     grid = (bh, lq // block_q, lk // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, kv_len=kv_len,
     )
     kwargs = {}
     if not interpret:
@@ -119,50 +137,238 @@ def _flash_fwd(
         )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running row max m
             pltpu.VMEM((block_q, 128), jnp.float32),  # running row sum l
             pltpu.VMEM((block_q, d), jnp.float32),  # un-normalized output
         ],
         interpret=interpret,
+        **kwargs,
     )(q3, k3, v3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+):
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = pl.program_id(1) * block_q
+    k_start = ki * block_k
+
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]  # [block_q, 1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < kv_len
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.exp(s - lse) * mask  # [block_q, block_k], fp32
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * jnp.asarray(scale, jnp.float32)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_block)
+    else:
+        _block()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+):
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    k_start = pl.program_id(1) * block_k
+    q_start = qi * block_q
+
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < kv_len
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.exp(s - lse) * mask
+        # dV += P^T dO
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * jnp.asarray(scale, jnp.float32)
+        # dK += dS^T Q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Q blocks entirely ABOVE the diagonal see this KV block masked out.
+        pl.when(q_start + block_q - 1 >= k_start)(_block)
+    else:
+        _block()
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, o3, lse3, do3, scale, causal, block_q, block_k,
+               kv_len, interpret):
+    bh, lq, d = q3.shape
+    lk = k3.shape[1]
+    # Δ = rowsum(dO ⊙ O): one fused elementwise+reduce pass, broadcast to
+    # the same [BH, Lq, 128] layout as LSE.
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+    delta3 = jnp.broadcast_to(delta[:, :, None], (bh, lq, 128))
+
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, kv_len=kv_len)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+
+    dq3 = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+        grid=(bh, lq // block_q, lk // block_k),
+        in_specs=[q_spec, kv_spec_q, kv_spec_q, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    # dK/dV: grid puts the KV block second, Q innermost (the recurrence).
+    q_spec_i = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    row_spec_i = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), v3.dtype),
+        ],
+        grid=(bh, lk // block_k, lq // block_q),
+        in_specs=[q_spec_i, kv_spec, kv_spec, q_spec_i, row_spec_i, row_spec_i],
+        out_specs=[kv_spec, kv_spec],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q3, k3, v3, do3, lse3, delta3)
+    return dq3, dk3, dv3
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, kv_len, interpret):
+    out, _ = _flash_vjp_fwd(
+        q, k, v, scale, causal, block_q, block_k, kv_len, interpret
+    )
+    return out
+
+
+def _to3(x):
+    b, l, h, d = x.shape
+    return jnp.moveaxis(x, 2, 1).reshape(b * h, l, d)
+
+
+def _from3(x3, b, h):
+    bh, l, d = x3.shape
+    return jnp.moveaxis(x3.reshape(b, h, l, d), 1, 2)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, kv_len,
+                   interpret):
     b, lq, h, d = q.shape
-    lk = k.shape[1]
-    to3 = lambda x, l: jnp.moveaxis(x, 2, 1).reshape(b * h, l, d)
-    o3 = _flash_fwd(
-        to3(q, lq), to3(k, lk), to3(v, lk), scale, causal, block_q, block_k,
+    o3, lse3 = _flash_fwd(
+        _to3(q), _to3(k), _to3(v), scale, causal, block_q, block_k, kv_len,
         interpret,
     )
-    return jnp.moveaxis(o3.reshape(b, h, lq, d), 1, 2)
+    return _from3(o3, b, h), (q, k, v, o3, lse3)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret), (q, k, v)
-
-
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # Rematerialized blockwise backward (bit-matches the forward math up to
-    # accumulation order); a Pallas backward kernel slots in here later.
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, scale=scale, block_size=block_k
-        ),
-        q, k, v,
+def _flash_vjp_bwd(scale, causal, block_q, block_k, kv_len, interpret, res, g):
+    q, k, v, o3, lse3 = res
+    b, lq, h, d = q.shape
+    dq3, dk3, dv3 = _flash_bwd(
+        _to3(q), _to3(k), _to3(v), o3, lse3, _to3(g.astype(q.dtype)),
+        scale, causal, block_q, block_k, kv_len, interpret,
     )
-    return vjp(g)
+    return _from3(dq3, b, h), _from3(dk3, b, h), _from3(dv3, b, h)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -182,17 +388,23 @@ def flash_attention(
     """FlashAttention: ``softmax(QKᵀ·scale)V`` tiled through VMEM.
 
     Args:
-      q, k, v: ``[B, L, H, D]``; each L must be a multiple of its block size
-        (blocks are clamped to L for short sequences).
-      interpret: run the kernel in the Pallas interpreter (CPU testing).
+      q, k, v: ``[B, L, H, D]``; any lengths — inputs are zero-padded to
+        block multiples and padded key positions are masked in-kernel
+        (round 1 required exact multiples).
+      interpret: run the kernels in the Pallas interpreter (CPU testing).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk = q.shape[1], k.shape[1]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
-    if lq % block_q or lk % block_k:
-        raise ValueError(
-            f"sequence lengths ({lq}, {lk}) must be multiples of the block "
-            f"sizes ({block_q}, {block_k})"
+    block_q = min(block_q, max(lq, 1))
+    block_k = min(block_k, max(lk, 1))
+    pad_q = (-lq) % block_q
+    pad_k = (-lk) % block_k
+    if pad_q or pad_k:
+        padq = lambda x: jnp.pad(x, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        padk = lambda x: jnp.pad(x, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        out = _flash(
+            padq(q), padk(k), padk(v), scale, causal, block_q, block_k, lk,
+            interpret,
         )
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+        return out[:, :lq]
+    return _flash(q, k, v, scale, causal, block_q, block_k, lk, interpret)

@@ -131,12 +131,48 @@ def test_flash_grads_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
-def test_flash_indivisible_raises():
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_arbitrary_lengths_match_dense(causal):
+    """r2: lengths that are NOT block multiples work via zero padding +
+    in-kernel key masking (round 1 raised), values AND gradients."""
     from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
-    q, k, v = qkv(l=30)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    q, k, v = qkv(l=30, d=16)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16, interpret=True
+    )
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+    g_f = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                            interpret=True) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_d = jax.grad(
+        lambda q, k, v: jnp.sum(dense_attention(q, k, v, causal=causal) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_f, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_flash_cross_attention_lengths():
+    """Lq != Lk (cross/prefix shapes), non-causal, with key padding."""
+    from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(2, 24, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 50, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 50, 2, 16)), jnp.float32)
+    out = flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
 
 
 def test_flash_lm_forward_matches_dense():
