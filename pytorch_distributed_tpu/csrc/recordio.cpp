@@ -25,6 +25,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #include <fcntl.h>
@@ -163,6 +164,94 @@ int64_t tpr_read_batch(void* handle, const uint64_t* indices, int64_t count,
     int64_t status = tpr_read(handle, indices[k], buf + buf_offsets[k], verify_crc);
     if (status < 0) return status;
   }
+  return 0;
+}
+
+// Batched crop/flip/collate over RAW image records (data/raw.py layout:
+// label u32 | h u16 | w u16 | h*w*3 uint8 RGB). The whole batch — read,
+// header parse, crop window copy, optional horizontal flip, label extract —
+// happens here in one call with no per-sample Python work and no GIL
+// (ctypes releases it): the native half of the decode-free input path.
+//
+// out_images is [count, crop, crop, 3] uint8, out_labels [count] int32;
+// tops/lefts give each sample's crop origin, flips[k] != 0 mirrors
+// horizontally. expect_h/expect_w pin the stored image size the CALLER
+// drew the crop coordinates for: a record whose header disagrees fails
+// with -3 (the Python side then falls back to the per-sample path, which
+// reads true per-record sizes) instead of silently cropping with a wrong
+// distribution. Work is split over n_threads (pread is stateless, so
+// threads share the handle safely). Returns 0; -1 on I/O/bounds error;
+// -3 on a size mismatch.
+int64_t tpr_crop_batch(void* handle, const uint64_t* indices, int64_t count,
+                       const int32_t* tops, const int32_t* lefts,
+                       const uint8_t* flips, int32_t crop,
+                       int32_t expect_h, int32_t expect_w,
+                       uint8_t* out_images, int32_t* out_labels,
+                       int n_threads) {
+  auto* r = static_cast<Reader*>(handle);
+  const uint64_t out_stride =
+      static_cast<uint64_t>(crop) * static_cast<uint64_t>(crop) * 3;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > count) n_threads = static_cast<int>(count);
+
+  std::vector<int64_t> status(static_cast<size_t>(n_threads), 0);
+  auto worker = [&](int t) {
+    std::vector<uint8_t> scratch;
+    for (int64_t k = t; k < count; k += n_threads) {
+      uint64_t i = indices[k];
+      if (i >= r->n) { status[t] = -1; return; }
+      uint64_t len = r->offsets[i + 1] - r->offsets[i];
+      if (len < 8) { status[t] = -1; return; }
+      scratch.resize(len);
+      if (!read_exact(r->fd, scratch.data(), len,
+                      r->payload_start + r->offsets[i])) {
+        status[t] = -1;
+        return;
+      }
+      int32_t label;
+      uint16_t h, w;
+      memcpy(&label, scratch.data(), 4);
+      memcpy(&h, scratch.data() + 4, 2);
+      memcpy(&w, scratch.data() + 6, 2);
+      if (h != expect_h || w != expect_w) { status[t] = -3; return; }
+      const int32_t top = tops[k], left = lefts[k];
+      if (top < 0 || left < 0 || top + crop > h || left + crop > w ||
+          len < 8 + static_cast<uint64_t>(h) * w * 3) {
+        status[t] = -1;
+        return;
+      }
+      const uint8_t* img = scratch.data() + 8;
+      uint8_t* dst = out_images + static_cast<uint64_t>(k) * out_stride;
+      const uint64_t row_bytes = static_cast<uint64_t>(crop) * 3;
+      for (int32_t y = 0; y < crop; ++y) {
+        const uint8_t* src =
+            img + (static_cast<uint64_t>(top + y) * w + left) * 3;
+        uint8_t* drow = dst + static_cast<uint64_t>(y) * row_bytes;
+        if (flips[k]) {
+          for (int32_t x = 0; x < crop; ++x) {
+            const uint8_t* px = src + static_cast<uint64_t>(crop - 1 - x) * 3;
+            drow[3 * x + 0] = px[0];
+            drow[3 * x + 1] = px[1];
+            drow[3 * x + 2] = px[2];
+          }
+        } else {
+          memcpy(drow, src, row_bytes);
+        }
+      }
+      out_labels[k] = label;
+    }
+  };
+
+  if (n_threads == 1) {
+    worker(0);
+    return status[0];
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(static_cast<size_t>(n_threads));
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+  for (auto& th : threads) th.join();
+  for (int t = 0; t < n_threads; ++t)
+    if (status[t] < 0) return status[t];
   return 0;
 }
 

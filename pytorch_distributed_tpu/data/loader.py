@@ -97,6 +97,17 @@ class DataLoader:
 
     def _fetch(self, batch_indices: np.ndarray, pool) -> dict:
         ints = [int(i) for i in batch_indices]
+        if hasattr(self.dataset, "collate_batch") and self.collate_fn is _collate:
+            # Whole-batch fast path (e.g. RawImageNet's native C crop+
+            # collate); a custom collate_fn disables it — the caller's
+            # collate must always run. make_rng derives per-sample rngs
+            # exactly as _getitem does (and only if the path applies), so
+            # the two paths produce identical batches.
+            epoch = getattr(self.sampler, "epoch", 0)
+            make_rng = lambda i: np.random.default_rng([self.seed, epoch, i])
+            batch = self.dataset.collate_batch(ints, make_rng)
+            if batch is not None:
+                return batch
         if pool is not None:
             samples = list(pool.map(self._getitem, ints))
         else:

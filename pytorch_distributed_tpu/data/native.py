@@ -30,7 +30,8 @@ def _build() -> str | None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO + ".tmp"]
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC,
+           "-o", _SO + ".tmp"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError) as e:
@@ -73,12 +74,32 @@ def _load():
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_int,
         ]
+        lib.tpr_crop_batch.restype = ctypes.c_int64
+        lib.tpr_crop_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+        ]
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+class SizeMismatch(IOError):
+    """A raw record's stored (h, w) differs from what the caller planned
+    crop coordinates for — fall back to the per-record-size path."""
 
 
 class NativeReader:
@@ -131,6 +152,59 @@ class NativeReader:
         return [
             raw[int(o) : int(o) + int(s)] for o, s in zip(offsets, sizes)
         ]
+
+    def crop_batch(
+        self,
+        indices: Sequence[int],
+        tops: Sequence[int],
+        lefts: Sequence[int],
+        flips: Sequence[bool],
+        crop: int,
+        expect_h: int,
+        expect_w: int,
+        n_threads: int = 0,
+    ):
+        """Read RAW image records (data/raw.py layout) and return
+        (images [B, crop, crop, 3] uint8, labels [B] int32) with the crop
+        windows and horizontal flips applied in C — one copy, no GIL.
+
+        ``expect_h``/``expect_w`` pin the stored size the crop coordinates
+        were drawn for; a record whose header disagrees raises
+        ``SizeMismatch`` (caller falls back to the per-record-size path).
+        """
+        idx = np.ascontiguousarray(indices, np.uint64)
+        t = np.ascontiguousarray(tops, np.int32)
+        l = np.ascontiguousarray(lefts, np.int32)
+        f = np.ascontiguousarray(flips, np.uint8)
+        b = len(idx)
+        images = np.empty((b, crop, crop, 3), np.uint8)
+        labels = np.empty((b,), np.int32)
+        if n_threads <= 0:
+            n_threads = min(os.cpu_count() or 1, 8)
+        status = self._lib.tpr_crop_batch(
+            self._h,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            b,
+            t.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            l.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            f.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            crop,
+            expect_h,
+            expect_w,
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_threads,
+        )
+        if status == -3:
+            raise SizeMismatch(
+                f"record size differs from expected {expect_h}x{expect_w}"
+            )
+        if status < 0:
+            raise IOError(
+                "native crop_batch failed (bad index, truncated record, or "
+                "crop window out of bounds)"
+            )
+        return images, labels
 
     def close(self):
         if self._h:

@@ -165,6 +165,7 @@ class RawImageNet:
         self.reader = PackedRecordReader(self.path, use_native=use_native)
         # see ImageNet.verify_crc: per-read CRC costs ~3x read bandwidth
         self.verify_crc = verify_crc
+        self._hw = None  # stored image size, lazily read from record 0
         if aug is None:
             aug = "rrc" if split == "train" else "none"
         if aug == "rrc":
@@ -185,6 +186,55 @@ class RawImageNet:
 
     def __getitem__(self, i: int):
         return self.getitem_rng(i, np.random.default_rng())
+
+    def collate_batch(self, indices, make_rng):
+        """Native whole-batch fast path (csrc ``tpr_crop_batch``): read +
+        crop + flip + collate in one C call — one copy, no GIL, threaded.
+
+        ``make_rng(i)`` builds the per-sample augmentation rng (only called
+        once this path has decided to run); crop coordinates/flips are
+        drawn in the SAME order as the Python transforms, so the two paths
+        are bit-identical (parity-tested). Returns None when unavailable —
+        no native reader, an augmentation that needs PIL, per-read CRC
+        verification requested (the C kernel doesn't verify), or a record
+        whose stored size differs from record 0's (the kernel checks every
+        header and we fall back to the per-record-size Python path) — and
+        the loader then does per-sample fetch.
+        """
+        nat = self.reader._native
+        if (
+            nat is None
+            or self.verify_crc
+            or not isinstance(self.transform, (_RandomCropFlip, _EvalCrop))
+        ):
+            return None
+        s = self.transform.size
+        if self._hw is None:
+            arr, _ = decode_raw_record(self.reader.read(int(indices[0]), False))
+            self._hw = arr.shape[:2]
+        h, w = self._hw
+        n = len(indices)
+        if isinstance(self.transform, _RandomCropFlip):
+            tops, lefts, flips = [], [], []
+            for i in indices:
+                rng = make_rng(i)
+                # exact rng consumption order of _RandomCropFlip.__call__
+                tops.append(int(rng.integers(0, h - s + 1)) if h > s else 0)
+                lefts.append(int(rng.integers(0, w - s + 1)) if w > s else 0)
+                flips.append(bool(rng.random() < 0.5))
+        else:
+            tops = [(h - s) // 2] * n
+            lefts = [(w - s) // 2] * n
+            flips = [False] * n
+        from pytorch_distributed_tpu.data.native import SizeMismatch
+
+        try:
+            images, labels = nat.crop_batch(
+                indices, tops, lefts, flips, s, h, w
+            )
+        except SizeMismatch:
+            return None  # variable-size split: per-sample path reads true sizes
+        return {"image": images, "label": labels}
 
     def loader(self, batch_size: int, sampler=None, num_workers: int = 4,
                drop_last: bool = True, prefetch: int = 2, **_compat):

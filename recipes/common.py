@@ -32,6 +32,12 @@ from pytorch_distributed_tpu.utils.env import set_env
 set_env("202607")
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # A site TPU plugin may force its own platform list into the jax config,
+    # overriding JAX_PLATFORMS; honor the caller's explicit CPU request so
+    # --xla_force_host_platform_device_count virtual devices are visible.
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
 from pytorch_distributed_tpu.models import resnet50
@@ -124,3 +130,33 @@ def run(args, mesh, precision: str = "fp32") -> dict:
     summary = trainer.fit()
     rank0_print(f"done: best acc1 {summary.get('best_acc', 0.0):.2f}")
     return summary
+
+
+def parse_lm_args(description: str) -> argparse.Namespace:
+    """Arguments for the LM pretraining recipe (recipes/lm_pretrain.py)."""
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--synthetic", action="store_true",
+                   help="deterministic fake tokens instead of a corpus")
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny model/epochs for smoke-testing on CPU")
+    p.add_argument("--tokens", default=None,
+                   help="flat int token array (.npy), windowed to --seq-len")
+    p.add_argument("--save-dir", default="output_lm")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="sequences per data-replica step")
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--layers", type=int, default=12)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--embed-dim", type=int, default=768)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--attention", default="flash",
+                   choices=["dense", "blockwise", "flash", "ring"],
+                   help="attention path when the seq axis is unsharded")
+    p.add_argument("--seq-parallel", type=int, default=2,
+                   help="sequence-parallel degree (ring attention when > 1)")
+    p.add_argument("--model-parallel", type=int, default=1,
+                   help="tensor-parallel degree")
+    return p.parse_args()

@@ -1,0 +1,128 @@
+"""Transformer LM pretraining over a (data, seq, model) mesh.
+
+Beyond the reference's capability surface (it has no attention model,
+SURVEY.md §5 long-context ABSENT) but a first-class recipe here: the same
+zero-required-args ergonomics, trainer contracts (suspend/resume,
+latest/best checkpoints, JSONL metrics), and env rendezvous as the ResNet
+recipes, driving ``LMTrainer`` with ring-attention sequence parallelism
+and optional tensor parallelism.
+
+    python recipes/lm_pretrain.py --tiny            # CPU smoke (8 virtual devices)
+    python recipes/lm_pretrain.py --tokens corpus.npy --seq-len 2048
+    MASTER_IP=… WORLD_SIZE=… RANK=… python recipes/lm_pretrain.py   # pod
+
+The mesh factors the device count as dp×sp×tp from --seq-parallel /
+--model-parallel (default: sequence parallelism on, tp off). Token data is
+a flat int array (.npy or memmap-able raw int32) windowed to --seq-len;
+--synthetic generates deterministic fake tokens.
+"""
+
+from common import parse_lm_args  # noqa: E402  (bootstraps sys.path)
+
+import pytorch_distributed_tpu as pdt
+
+pdt.set_env("202607")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pytorch_distributed_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig,
+    tiny_config,
+)
+from pytorch_distributed_tpu.parallel import (  # noqa: E402
+    global_batch_size,
+    init_process_group,
+    make_mesh,
+)
+from pytorch_distributed_tpu.train import LMTrainer, LMTrainerConfig  # noqa: E402
+from pytorch_distributed_tpu.utils.logging import rank0_print  # noqa: E402
+from pytorch_distributed_tpu.utils.suspend import SuspendWatcher  # noqa: E402
+
+
+def build_token_datasets(args):
+    if args.synthetic or args.tiny:
+        from pytorch_distributed_tpu.data import SyntheticTokens
+
+        vocab = 128 if args.tiny else 32000
+        seq = 32 if args.tiny else args.seq_len
+        n = 64 if args.tiny else 4096
+        return (
+            SyntheticTokens(n, seq, vocab),
+            SyntheticTokens(max(n // 8, 8), seq, vocab, seed=1),
+            seq,
+            vocab,
+        )
+    import numpy as np
+
+    from pytorch_distributed_tpu.data import TokenArrayDataset
+
+    if not args.tokens:
+        raise SystemExit("--tokens <corpus.npy> required without --synthetic")
+    tokens = np.load(args.tokens, mmap_mode="r")
+    n_val = max(len(tokens) // 100, args.seq_len)
+    return (
+        TokenArrayDataset(tokens[:-n_val], args.seq_len),
+        TokenArrayDataset(tokens[-n_val:], args.seq_len),
+        args.seq_len,
+        args.vocab_size,
+    )
+
+
+def main() -> None:
+    args = parse_lm_args(__doc__)
+    init_process_group()
+    train_ds, val_ds, seq_len, vocab = build_token_datasets(args)
+
+    sp = args.seq_parallel
+    tp = args.model_parallel
+    n = jax.device_count()
+    if n % (sp * tp):
+        raise SystemExit(f"{n} devices not divisible by sp*tp={sp * tp}")
+    mesh = make_mesh(data_parallel=n // (sp * tp), seq_parallel=sp,
+                     model_parallel=tp)
+
+    if args.tiny:
+        model_cfg = tiny_config(
+            attention="ring" if sp > 1 else "dense",
+            model_axis="model" if tp > 1 else None,
+            tp_size=tp,
+            dropout=args.dropout,
+        )
+    else:
+        model_cfg = TransformerConfig(
+            vocab_size=vocab,
+            num_layers=args.layers,
+            num_heads=args.heads,
+            embed_dim=args.embed_dim,
+            max_seq_len=seq_len,
+            dropout=args.dropout,
+            dtype=jnp.bfloat16,
+            attention="ring" if sp > 1 else args.attention,
+            model_axis="model" if tp > 1 else None,
+            tp_size=tp,
+        )
+
+    cfg = LMTrainerConfig(
+        epochs=args.epochs if args.epochs is not None else (2 if args.tiny else 1),
+        batch_size=args.batch_size if args.batch_size is not None
+        else (2 if args.tiny else 8),
+        lr=args.lr,
+        warmup_steps=0 if args.tiny else 2000,
+        save_dir=args.save_dir,
+        num_workers=0 if args.tiny else 4,
+    )
+    trainer = LMTrainer(model_cfg, train_ds, val_ds, cfg, mesh=mesh,
+                        suspend_watcher=SuspendWatcher())
+    rank0_print(
+        f"devices: {jax.device_count()} ({jax.process_count()} hosts), "
+        f"mesh {dict(mesh.shape)}, global batch "
+        f"{global_batch_size(mesh, cfg.batch_size)} seqs × {seq_len} tokens, "
+        f"attention {model_cfg.attention}, tp {tp}"
+    )
+    summary = trainer.fit()
+    rank0_print(f"done: best ppl {summary.get('best_ppl', float('inf')):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -138,7 +138,14 @@ def main() -> None:
                          n, workers)
         bench_loader("raw_rrc", RawImageNet("train", data_dir=tmp, aug="rrc"),
                      n, workers)
-        bench_loader("raw_crop", RawImageNet("train", data_dir=tmp, aug="crop"),
+        bench_loader("raw_crop_py",
+                     RawImageNet("train", data_dir=tmp, aug="crop",
+                                 use_native=False),
+                     n, workers)
+        # native whole-batch C path (tpr_crop_batch): read+crop+flip+collate
+        # in one GIL-free threaded call
+        bench_loader("raw_crop_native",
+                     RawImageNet("train", data_dir=tmp, aug="crop"),
                      n, workers)
         try:
             bench_end_to_end(RawImageNet("train", data_dir=tmp, aug="crop"),

@@ -129,3 +129,101 @@ def test_device_normalization_matches_host(tmp_path):
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
                                    atol=1e-6, err_msg=str(p1))
+
+
+def test_native_collate_batch_matches_python_path(tmp_path):
+    """The C tpr_crop_batch fast path must be bit-identical to the Python
+    per-sample path — same crops, flips, labels — for train and eval augs."""
+    from pytorch_distributed_tpu.data import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    make_split(tmp_path, n=16, size=64)
+    for aug in ("crop", "none"):
+        ds_n = RawImageNet("train", data_dir=os.fspath(tmp_path), crop_size=32,
+                           aug=aug)
+        ds_p = RawImageNet("train", data_dir=os.fspath(tmp_path), crop_size=32,
+                           aug=aug, use_native=False)
+        assert ds_n.reader._native is not None
+        loader_n = DataLoader(ds_n, batch_size=8, num_workers=0, seed=3)
+        loader_p = DataLoader(ds_p, batch_size=8, num_workers=0, seed=3)
+        for bn, bp in zip(loader_n.iter_batches(0), loader_p.iter_batches(0)):
+            assert bn["image"].dtype == np.uint8
+            np.testing.assert_array_equal(bn["image"], bp["image"])
+            np.testing.assert_array_equal(bn["label"], bp["label"])
+
+
+def test_native_collate_falls_back_for_rrc_and_crc(tmp_path):
+    """collate_batch must decline (return None) when the aug needs PIL or
+    when per-read CRC verification was requested (the C kernel doesn't
+    verify) — the loader then takes the per-sample path."""
+    make_split(tmp_path, n=8, size=64)
+    mk = lambda i: np.random.default_rng(i)
+    ds = RawImageNet("train", data_dir=os.fspath(tmp_path), crop_size=32,
+                     aug="rrc")
+    assert ds.collate_batch([0, 1], mk) is None
+    batch = next(iter(DataLoader(ds, batch_size=4, num_workers=0)))
+    assert batch["image"].shape == (4, 32, 32, 3)
+    ds_crc = RawImageNet("train", data_dir=os.fspath(tmp_path), crop_size=32,
+                         aug="crop", verify_crc=True)
+    assert ds_crc.collate_batch([0, 1], mk) is None
+
+
+def test_native_collate_falls_back_for_variable_sizes(tmp_path):
+    """A split with per-record sizes must not silently crop with record 0's
+    dims: the C kernel rejects the mismatch and the per-sample path (which
+    reads true sizes) serves the batch."""
+    from pytorch_distributed_tpu.data import native
+    from pytorch_distributed_tpu.data.packed_record import PackedRecordWriter
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(0)
+    path = os.fspath(tmp_path / "train.rawtprc")
+    with PackedRecordWriter(path) as w:
+        for i, size in enumerate((64, 48, 64, 96)):
+            img = rng.integers(0, 255, (size, size, 3)).astype(np.uint8)
+            w.write(encode_raw_record(img, i))
+    ds = RawImageNet("train", data_dir=os.fspath(tmp_path), crop_size=32,
+                     aug="crop")
+    assert ds.collate_batch([0, 1, 2, 3],
+                            lambda i: np.random.default_rng(i)) is None
+    batch = next(iter(DataLoader(ds, batch_size=4, num_workers=0, seed=5)))
+    assert batch["image"].shape == (4, 32, 32, 3)
+    # and the per-sample path's samples match direct dataset access
+    a, _ = ds.getitem_rng(1, np.random.default_rng([5, 0, 1]))
+    np.testing.assert_array_equal(batch["image"][1], a)
+
+
+def test_custom_collate_fn_disables_fast_path(tmp_path):
+    make_split(tmp_path, n=8, size=64)
+    ds = RawImageNet("train", data_dir=os.fspath(tmp_path), crop_size=32,
+                     aug="crop")
+    calls = []
+
+    def my_collate(samples):
+        calls.append(len(samples))
+        images = np.stack([s[0] for s in samples])
+        return {"image": images, "label": np.zeros(len(samples), np.int32),
+                "extra": True}
+
+    batch = next(iter(DataLoader(ds, batch_size=4, num_workers=0,
+                                 collate_fn=my_collate)))
+    assert calls and batch["extra"] is True
+
+
+def test_native_crop_batch_bounds_check(tmp_path):
+    from pytorch_distributed_tpu.data import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    path, _ = make_split(tmp_path, n=4, size=32)
+    from pytorch_distributed_tpu.data.packed_record import PackedRecordReader
+
+    r = PackedRecordReader(path)
+    with pytest.raises(IOError):
+        r._native.crop_batch([0], [30], [0], [False], 16, 32, 32)  # top+crop > h
+    with pytest.raises(IOError):
+        r._native.crop_batch([99], [0], [0], [False], 16, 32, 32)  # bad index
+    with pytest.raises(native.SizeMismatch):
+        r._native.crop_batch([0], [0], [0], [False], 16, 64, 64)
