@@ -84,15 +84,20 @@ class DataLoader:
         for lo in range(0, usable, self.batch_size):
             yield indices[lo : lo + self.batch_size]
 
+    def _make_rng(self, i: int) -> np.random.Generator:
+        """THE per-sample augmentation rng: derived from (loader seed,
+        epoch, dataset index) so resumed runs reproduce the same
+        crops/flips. Single definition — the per-sample path and the
+        whole-batch fast path must draw from identical streams for their
+        bit-parity guarantee to hold."""
+        epoch = getattr(self.sampler, "epoch", 0)
+        return np.random.default_rng([self.seed, epoch, i])
+
     def _getitem(self, i: int):
-        """Fetch sample i with a deterministic augmentation RNG derived from
-        (loader seed, epoch, dataset index) — a resumed run reproduces the
-        same crops/flips an uninterrupted run would have applied."""
+        """Fetch sample i with the deterministic augmentation RNG."""
         dataset = self.dataset
         if hasattr(dataset, "getitem_rng"):
-            epoch = getattr(self.sampler, "epoch", 0)
-            rng = np.random.default_rng([self.seed, epoch, i])
-            return dataset.getitem_rng(i, rng)
+            return dataset.getitem_rng(i, self._make_rng(i))
         return dataset[i]
 
     def _fetch(self, batch_indices: np.ndarray, pool) -> dict:
@@ -100,12 +105,10 @@ class DataLoader:
         if hasattr(self.dataset, "collate_batch") and self.collate_fn is _collate:
             # Whole-batch fast path (e.g. RawImageNet's native C crop+
             # collate); a custom collate_fn disables it — the caller's
-            # collate must always run. make_rng derives per-sample rngs
-            # exactly as _getitem does (and only if the path applies), so
-            # the two paths produce identical batches.
-            epoch = getattr(self.sampler, "epoch", 0)
-            make_rng = lambda i: np.random.default_rng([self.seed, epoch, i])
-            batch = self.dataset.collate_batch(ints, make_rng)
+            # collate must always run. _make_rng is shared with _getitem
+            # (and only called if the path applies), so the two paths draw
+            # identical augmentation streams.
+            batch = self.dataset.collate_batch(ints, self._make_rng)
             if batch is not None:
                 return batch
         if pool is not None:

@@ -166,6 +166,7 @@ class RawImageNet:
         # see ImageNet.verify_crc: per-read CRC costs ~3x read bandwidth
         self.verify_crc = verify_crc
         self._hw = None  # stored image size, lazily read from record 0
+        self._native_declined = False  # latched on a variable-size split
         if aug is None:
             aug = "rrc" if split == "train" else "none"
         if aug == "rrc":
@@ -204,6 +205,7 @@ class RawImageNet:
         nat = self.reader._native
         if (
             nat is None
+            or self._native_declined
             or self.verify_crc
             or not isinstance(self.transform, (_RandomCropFlip, _EvalCrop))
         ):
@@ -213,6 +215,10 @@ class RawImageNet:
             arr, _ = decode_raw_record(self.reader.read(int(indices[0]), False))
             self._hw = arr.shape[:2]
         h, w = self._hw
+        if h < s or w < s:
+            # stored image smaller than the crop: the Python transforms
+            # degrade gracefully (no-crop slice); the C kernel would error
+            return None
         n = len(indices)
         if isinstance(self.transform, _RandomCropFlip):
             tops, lefts, flips = [], [], []
@@ -233,7 +239,11 @@ class RawImageNet:
                 indices, tops, lefts, flips, s, h, w
             )
         except SizeMismatch:
-            return None  # variable-size split: per-sample path reads true sizes
+            # variable-size split: the per-sample path reads true sizes.
+            # Latch the decision — retrying the kernel every batch would
+            # read (and discard) each batch twice, forever.
+            self._native_declined = True
+            return None
         return {"image": images, "label": labels}
 
     def loader(self, batch_size: int, sampler=None, num_workers: int = 4,

@@ -48,17 +48,24 @@ from pytorch_distributed_tpu.utils.logging import rank0_print
 from pytorch_distributed_tpu.utils.suspend import SuspendWatcher
 
 
-def parse_args(description: str) -> argparse.Namespace:
+def _base_parser(description: str, save_dir: str,
+                 batch_help: str) -> argparse.ArgumentParser:
+    """Flags every recipe shares — one definition, no drift."""
     p = argparse.ArgumentParser(description=description)
     p.add_argument("--synthetic", action="store_true",
-                   help="synthetic data instead of TPRC ImageNet")
+                   help="synthetic data instead of on-disk records")
     p.add_argument("--tiny", action="store_true",
                    help="tiny model/epochs for smoke-testing on CPU")
-    p.add_argument("--data-dir", default=None, help="TPRC ImageNet directory")
-    p.add_argument("--save-dir", default="output", help="checkpoint directory")
+    p.add_argument("--save-dir", default=save_dir, help="checkpoint directory")
     p.add_argument("--epochs", type=int, default=None)
-    p.add_argument("--batch-size", type=int, default=None,
-                   help="per-replica batch size (ref default 400)")
+    p.add_argument("--batch-size", type=int, default=None, help=batch_help)
+    return p
+
+
+def parse_args(description: str) -> argparse.Namespace:
+    p = _base_parser(description, save_dir="output",
+                     batch_help="per-replica batch size (ref default 400)")
+    p.add_argument("--data-dir", default=None, help="TPRC ImageNet directory")
     return p.parse_args()
 
 
@@ -134,17 +141,10 @@ def run(args, mesh, precision: str = "fp32") -> dict:
 
 def parse_lm_args(description: str) -> argparse.Namespace:
     """Arguments for the LM pretraining recipe (recipes/lm_pretrain.py)."""
-    p = argparse.ArgumentParser(description=description)
-    p.add_argument("--synthetic", action="store_true",
-                   help="deterministic fake tokens instead of a corpus")
-    p.add_argument("--tiny", action="store_true",
-                   help="tiny model/epochs for smoke-testing on CPU")
+    p = _base_parser(description, save_dir="output_lm",
+                     batch_help="sequences per data-replica step")
     p.add_argument("--tokens", default=None,
                    help="flat int token array (.npy), windowed to --seq-len")
-    p.add_argument("--save-dir", default="output_lm")
-    p.add_argument("--epochs", type=int, default=None)
-    p.add_argument("--batch-size", type=int, default=None,
-                   help="sequences per data-replica step")
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--vocab-size", type=int, default=32000)
     p.add_argument("--layers", type=int, default=12)

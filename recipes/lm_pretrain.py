@@ -44,7 +44,7 @@ def build_token_datasets(args):
     if args.synthetic or args.tiny:
         from pytorch_distributed_tpu.data import SyntheticTokens
 
-        vocab = 128 if args.tiny else 32000
+        vocab = 128 if args.tiny else args.vocab_size
         seq = 32 if args.tiny else args.seq_len
         n = 64 if args.tiny else 4096
         return (

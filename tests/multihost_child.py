@@ -13,6 +13,11 @@ Modes (argv[1]):
            SIGTERMs ONE rank mid-epoch and both processes must checkpoint
            (rank 0) and yield together. Touches <save_dir>/started.<rank>
            once training has begun so the parent knows when to fire.
+  lm       LMTrainer over a dp2×sp2×tp2 GLOBAL mesh: ring attention and
+           tensor parallelism span the two processes, so the checkpoint
+           payload's gather_global really runs its cross-process
+           process_allgather collective (TP-sharded leaves are not locally
+           addressable). Prints the same JSON result line as ``train``.
 """
 
 import json
@@ -31,6 +36,44 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+
+def run_lm(save_dir: str) -> None:
+    from pytorch_distributed_tpu.data import SyntheticTokens
+    from pytorch_distributed_tpu.models.transformer import tiny_config
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.distributed import get_rank, get_world_size
+    from pytorch_distributed_tpu.train import LMTrainer, LMTrainerConfig
+
+    mesh = make_mesh(data_parallel=2, seq_parallel=2, model_parallel=2)
+    model_cfg = tiny_config(
+        attention="ring", model_axis="model", tp_size=2, dropout=0.1
+    )
+    cfg = LMTrainerConfig(epochs=1, batch_size=2, lr=1e-2, save_dir=save_dir,
+                          num_workers=0, log_every=2)
+    train = SyntheticTokens(size=16, seq_len=32, vocab_size=128)
+    val = SyntheticTokens(size=8, seq_len=32, vocab_size=128, seed=9)
+    trainer = LMTrainer(model_cfg, train, val, cfg, mesh=mesh)
+    summary = trainer.fit()
+    # sanity: the TP qkv kernels really span processes (gather_global had
+    # to run its cross-process collective to checkpoint them)
+    qkv = trainer.state.params["block0"]["attn"]["qkv"]["kernel"]
+    assert not qkv.is_fully_addressable
+    from pytorch_distributed_tpu.utils.checkpoint import gather_global
+
+    param_l1 = float(
+        sum(np.abs(np.asarray(leaf)).sum()
+            for leaf in jax.tree.leaves(gather_global(trainer.state.params)))
+    )
+    print(json.dumps({
+        "rank": get_rank(),
+        "world": get_world_size(),
+        "val_loss": round(summary["loss"], 6),
+        "ppl": round(summary["ppl"], 4),
+        "best_acc": 0.0,
+        "param_l1": param_l1,
+        "final_step": int(jax.device_get(trainer.state.step)),
+    }))
 
 
 def main() -> None:
@@ -53,6 +96,10 @@ def main() -> None:
     assert get_world_size() == 2, get_world_size()
     assert jax.device_count() == 8, jax.device_count()
     assert is_primary() == (get_rank() == 0)
+
+    if mode == "lm":
+        run_lm(save_dir)
+        return
 
     model = ResNet(
         stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=10, num_filters=8

@@ -151,6 +151,27 @@ def test_multihost_suspend_agreement_and_resume(tmp_path):
     assert r0["param_l1"] == r1["param_l1"]
 
 
+def test_lm_trainer_two_process_tp_sharded_checkpoint(tmp_path):
+    """LMTrainer with ring attention + tensor parallelism spanning two
+    processes: TP-sharded leaves are NOT locally addressable, so the
+    checkpoint payload's gather_global must run its cross-process
+    process_allgather on all ranks (the exact path that would deadlock if
+    the gather were rank-0-gated). Asserts cross-host agreement of the
+    gathered params and psum'd metrics, and that best.ckpt landed."""
+    port = free_port()
+    save = os.fspath(tmp_path / "lm")
+    procs = [launch(r, port, "lm", save) for r in (0, 1)]
+    results = communicate(procs)
+    for rc, out, err in results:
+        assert rc == 0, f"lm child failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    r0, r1 = (result_line(out) for _, out, _ in results)
+    assert r0["world"] == r1["world"] == 2
+    assert r0["param_l1"] == r1["param_l1"]
+    assert r0["val_loss"] == r1["val_loss"]
+    assert r0["final_step"] == r1["final_step"] > 0
+    assert os.path.exists(os.path.join(save, "best.ckpt"))
+
+
 def test_suspend_sync_gt_one_defers_without_deadlock(tmp_path):
     """suspend_sync_every=3: a SIGTERM landing at a non-agreement step must
     be DEFERRED (latched) to the next agreement step, not acted on locally

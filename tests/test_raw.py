@@ -167,6 +167,11 @@ def test_native_collate_falls_back_for_rrc_and_crc(tmp_path):
     ds_crc = RawImageNet("train", data_dir=os.fspath(tmp_path), crop_size=32,
                          aug="crop", verify_crc=True)
     assert ds_crc.collate_batch([0, 1], mk) is None
+    # stored image smaller than the crop: Python degrades gracefully
+    # (no-crop slice), the C kernel would bounds-error — decline instead
+    ds_small = RawImageNet("train", data_dir=os.fspath(tmp_path),
+                           crop_size=128, aug="crop")
+    assert ds_small.collate_batch([0, 1], mk) is None
 
 
 def test_native_collate_falls_back_for_variable_sizes(tmp_path):
