@@ -139,7 +139,7 @@ def run(batch_size: int, tiny: bool, dtype=jnp.bfloat16, warmup: int = 8,
     return batch_size * iters / dt, dt / iters, duty
 
 
-def bench_flash_attention(l: int = 2048) -> dict:
+def bench_flash_attention(l: int = 4096) -> dict:
     """Pallas flash fwd+bwd vs XLA blockwise at one LM-shaped config
     (causal, B2 H4 D128) — the headline kernel comparison; the full sweep
     incl. dense and more lengths lives in scripts/bench_attention.py."""
@@ -229,16 +229,18 @@ def main() -> None:
     }
     if np.isfinite(duty):
         record["duty_cycle"] = round(duty, 4)
-    if not tiny and os.environ.get("BENCH_ATTN", "1") == "1":
-        try:
-            record.update(bench_flash_attention())
-        except Exception as e:
-            record["flash_attn_error"] = str(e)[:200]
+    # host-only data measurement FIRST: the attention section's jax
+    # machinery leaves background CPU load that depresses host-side numbers
     if not tiny and os.environ.get("BENCH_DATA", "1") == "1":
         try:
             record.update(bench_data_pipeline())
         except Exception as e:
             record["data_pipeline_error"] = str(e)[:200]
+    if not tiny and os.environ.get("BENCH_ATTN", "1") == "1":
+        try:
+            record.update(bench_flash_attention())
+        except Exception as e:
+            record["flash_attn_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_FP32", "1") == "1":
         fp32_bs = batch_size
         while True:
