@@ -167,6 +167,25 @@ def bench_flash_attention(l: int = 4096) -> dict:
     return out
 
 
+def bench_lm_training() -> dict:
+    """GPT-2-small-shaped LM train step with flash attention: the
+    capability-beyond-parity headline (tokens/s, MFU). Full config sweep in
+    scripts/bench_lm.py; ~51% MFU measured on v5e at L=1024 (BENCH_LM.md)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "scripts"))
+    import bench_lm
+
+    r = bench_lm.bench("flash", batch=8, seq=1024, iters=10, quiet=True)
+    return {
+        "lm_tokens_per_s": r["tokens_per_s"],
+        "lm_mfu": r["mfu"],
+        "lm_params_m": r["params_m"],
+        "lm_attention": "flash",
+    }
+
+
 def bench_data_pipeline(n: int = 2048) -> dict:
     """Host input-pipeline throughput: the raw fast path (RawImageNet,
     uint8, random-crop aug) through the real DataLoader. Measured per host
@@ -241,6 +260,11 @@ def main() -> None:
             record.update(bench_flash_attention())
         except Exception as e:
             record["flash_attn_error"] = str(e)[:200]
+    if not tiny and os.environ.get("BENCH_LM", "1") == "1":
+        try:
+            record.update(bench_lm_training())
+        except Exception as e:
+            record["lm_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_FP32", "1") == "1":
         fp32_bs = batch_size
         while True:
