@@ -41,7 +41,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
-    attention: str = "dense"  # dense | blockwise | flash | ring
+    attention: str = "dense"  # dense | blockwise | flash | ring | ring_flash
     block_size: int = 512  # kv block for blockwise attention
     seq_axis: str = SEQ_AXIS  # mesh axis for attention="ring"
     # Megatron-style tensor parallelism: set model_axis to the mesh's model
@@ -120,6 +120,24 @@ class Attention(nn.Module):
             base = position_offset - jax.lax.axis_index(cfg.seq_axis) * l
             out = ring_attention(
                 q, k, v, axis=cfg.seq_axis, causal=True, base_offset=base
+            )
+        elif cfg.attention == "ring_flash":
+            from pytorch_distributed_tpu.ops.ring_flash import (
+                ring_flash_attention,
+            )
+
+            # Same ring schedule, Pallas flash kernels per visiting shard
+            # (ops/ring_flash.py). Causal structure comes from ring
+            # positions, which is exact for any uniform position offset.
+            # Blocks must DIVIDE the shard length (the kernel has no pad
+            # path under the ring); take the largest divisor within the
+            # configured block size so any length works.
+            blk = min(cfg.block_size, l)
+            while l % blk:
+                blk -= 1
+            out = ring_flash_attention(
+                q, k, v, axis=cfg.seq_axis, causal=True,
+                block_q=blk, block_k=blk,
             )
         elif cfg.attention == "blockwise":
             out = blockwise_attention(

@@ -279,14 +279,21 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def compute_delta(do3, o3):
+    """Δ = rowsum(dO ⊙ O) broadcast to the [BH, Lq, 128] row layout LSE
+    uses — shard-invariant, so ring callers compute it ONCE outside their
+    ring loop and pass it in."""
+    bh, lq, _ = o3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+    return jnp.broadcast_to(delta[:, :, None], (bh, lq, 128))
+
+
 def _flash_bwd(q3, k3, v3, o3, lse3, do3, scale, causal, block_q, block_k,
-               kv_len, interpret):
+               kv_len, interpret, delta3=None):
     bh, lq, d = q3.shape
     lk = k3.shape[1]
-    # Δ = rowsum(dO ⊙ O): one fused elementwise+reduce pass, broadcast to
-    # the same [BH, Lq, 128] layout as LSE.
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
-    delta3 = jnp.broadcast_to(delta[:, :, None], (bh, lq, 128))
+    if delta3 is None:
+        delta3 = compute_delta(do3, o3)
 
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, kv_len=kv_len)

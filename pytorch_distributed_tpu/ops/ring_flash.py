@@ -1,0 +1,257 @@
+"""Ring attention at flash speed: sequence parallelism over the Pallas
+kernels.
+
+``parallel.sequence.ring_attention`` folds visiting KV shards with the XLA
+online-softmax block (exact, but ~2.6x slower end-to-end than the Pallas
+kernels at long L — BENCH_LM.md). This module runs the SAME ring schedule
+with the flash kernels doing the per-shard work, made exact by a
+ring-level ``jax.custom_vjp``:
+
+Forward (one ring pass):
+  each visiting shard is processed by the flash FORWARD kernel, which
+  returns its block output and row logsumexp; blocks merge by the standard
+  LSE combine ((m, l, acc) running state — mathematically the same
+  recurrence the kernel runs internally, applied shard-wise). Causal runs
+  use the contiguous-shard structure: a shard from a later ring position is
+  fully masked (skipped — no FLOPs), an earlier one is fully visible
+  (non-causal kernel), the diagonal runs the causal kernel.
+
+Backward (a second ring pass; this is why the custom_vjp exists — the
+merge weights depend on the per-shard LSEs, and differentiating through
+them naively would need an lse-cotangent rule the kernel doesn't define):
+  with the FINAL output O and GLOBAL row LSE saved as residuals, the
+  FlashAttention-2 decomposition applies per KV shard independently:
+  Δ = rowsum(dO ⊙ O) once, then each visiting shard's (dQ-contribution,
+  dK, dV) comes from the flash BACKWARD kernels with the global LSE. dQ
+  accumulates locally; dK/dV accumulators TRAVEL WITH their shard around
+  the ring, so after a full circle every shard's gradients are complete
+  and home (one collective permutation per step, same overlap story as
+  the forward).
+
+Exactness: values match ``ring_attention``/dense to fp accumulation order;
+gradients match dense attention's (tests/test_ring_flash.py, values and
+all three grads). Requires equal-length shards with L_local a multiple of
+the block sizes (the LM's standard configuration); anything else should
+use ``ring_attention``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.ops.attention import NEG_INF
+from pytorch_distributed_tpu.ops.flash_attention import (
+    _flash_bwd,
+    _flash_fwd,
+    _from3,
+    _to3,
+    compute_delta,
+)
+from pytorch_distributed_tpu.parallel.mesh import SEQ_AXIS
+
+
+def _shard_fwd(q3, k3, v3, scale, causal_block, block_q, block_k, interpret):
+    """Flash forward on one visiting shard → (o3, lse [BH, L, 1])."""
+    o3, lse3 = _flash_fwd(
+        q3, k3, v3, scale, causal_block, block_q, block_k, k3.shape[1],
+        interpret,
+    )
+    return o3, lse3[:, :, :1]
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+)
+def _ring_flash(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd(
+        q, k, v, axis, causal, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+    s = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    b, lq, h, d = q.shape
+    q3, k3, v3 = _to3(q), _to3(k), _to3(v)
+    bh = q3.shape[0]
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def fold(carry_state, k_cur, v_cur, step):
+        m, l, acc = carry_state
+        src = jax.lax.rem(my - step + s, s)
+
+        def merge(o3, lse):
+            m_new = jnp.maximum(m, lse)
+            corr = jnp.exp(m - m_new)
+            w = jnp.exp(lse - m_new)
+            return (
+                m_new,
+                l * corr + w,
+                acc * corr + o3.astype(jnp.float32) * w,
+            )
+
+        def diag(_):
+            return merge(*_shard_fwd(q3, k_cur, v_cur, scale, True,
+                                     block_q, block_k, interpret))
+
+        def full(_):
+            return merge(*_shard_fwd(q3, k_cur, v_cur, scale, False,
+                                     block_q, block_k, interpret))
+
+        def skip(_):
+            return (m, l, acc)
+
+        if not causal:
+            return full(None)
+        # contiguous equal shards: src>my fully masked, src<my fully
+        # visible, src==my the causal diagonal
+        return jax.lax.cond(
+            src > my, skip,
+            lambda x: jax.lax.cond(src == my, diag, full, x),
+            None,
+        )
+
+    def body(carry, step):
+        state, (k_cur, v_cur) = carry
+        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis, perm)
+        state = fold(state, k_cur, v_cur, step)
+        return (state, (k_nxt, v_nxt)), None
+
+    init_state = (
+        jnp.full((bh, lq, 1), NEG_INF, jnp.float32),
+        jnp.zeros((bh, lq, 1), jnp.float32),
+        jnp.zeros((bh, lq, d), jnp.float32),
+    )
+    if s > 1:
+        (state, (k_last, v_last)), _ = jax.lax.scan(
+            body, (init_state, (k3, v3)), jnp.arange(s - 1)
+        )
+    else:
+        state, (k_last, v_last) = init_state, (k3, v3)
+    m, l, acc = fold(state, k_last, v_last, s - 1)
+
+    l_safe = jnp.maximum(l, 1e-37)
+    o3 = (acc / l_safe).astype(q.dtype)
+    lse = jnp.where(l > 0.0, m + jnp.log(l_safe), NEG_INF)  # [BH, L, 1]
+    return _from3(o3, b, h), (q, k, v, o3, lse)
+
+
+def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o3, lse = res
+    b, lq, h, d = q.shape
+    s = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    q3, k3, v3, do3 = _to3(q), _to3(k), _to3(v), _to3(g.astype(q.dtype))
+    bh = q3.shape[0]
+    lse3 = jnp.broadcast_to(lse, (bh, lq, 128))
+    delta3 = compute_delta(do3, o3)  # shard-invariant: once, not per step
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def shard_bwd(k_cur, v_cur, causal_block):
+        return _flash_bwd(
+            q3, k_cur, v_cur, o3, lse3, do3, scale, causal_block,
+            block_q, block_k, k_cur.shape[1], interpret, delta3=delta3,
+        )
+
+    def fold(dq_acc, dk_cur, dv_cur, k_cur, v_cur, step):
+        src = jax.lax.rem(my - step + s, s)
+
+        def run(causal_block, _):
+            dq3, dk3, dv3 = shard_bwd(k_cur, v_cur, causal_block)
+            return (
+                dq_acc + dq3.astype(jnp.float32),
+                dk_cur + dk3.astype(jnp.float32),
+                dv_cur + dv3.astype(jnp.float32),
+            )
+
+        if not causal:
+            return run(False, None)
+        return jax.lax.cond(
+            src > my,
+            lambda _: (dq_acc, dk_cur, dv_cur),  # fully masked: no grads
+            lambda x: jax.lax.cond(
+                src == my, functools.partial(run, True),
+                functools.partial(run, False), x,
+            ),
+            None,
+        )
+
+    def body(carry, step):
+        dq_acc, (k_cur, v_cur, dk_cur, dv_cur) = carry
+        # k/v rotate from their pre-fold values (the fold consumes k_cur);
+        # the gradient accumulators rotate AFTER the fold so each shard's
+        # dk/dv travels with it carrying this device's contribution
+        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis, perm)
+        dq_acc, dk_new, dv_new = fold(dq_acc, dk_cur, dv_cur, k_cur, v_cur,
+                                      step)
+        dk_nxt, dv_nxt = jax.lax.ppermute((dk_new, dv_new), axis, perm)
+        return (dq_acc, (k_nxt, v_nxt, dk_nxt, dv_nxt)), None
+
+    zeros_kv = jnp.zeros((bh, k3.shape[1], d), jnp.float32)
+    init = (jnp.zeros((bh, lq, d), jnp.float32), (k3, v3, zeros_kv, zeros_kv))
+    if s > 1:
+        (dq_acc, (k_last, v_last, dk_last, dv_last)), _ = jax.lax.scan(
+            body, init, jnp.arange(s - 1)
+        )
+    else:
+        dq_acc, (k_last, v_last, dk_last, dv_last) = init
+    # final fold (no trailing rotation needed after it...) — the shard held
+    # now is the one that must end at THIS device: after s-1 rotations each
+    # device holds the shard originated at (my+1) mod s; one more rotation
+    # inside the last fold step would complete the circle. Fold first, then
+    # rotate once so every accumulator lands on its owner.
+    dq_acc, dk_new, dv_new = fold(dq_acc, dk_last, dv_last, k_last, v_last,
+                                  s - 1)
+    dk_home, dv_home = jax.lax.ppermute((dk_new, dv_new), axis, perm)
+
+    return (
+        _from3(dq_acc.astype(q.dtype), b, h),
+        _from3(dk_home.astype(k.dtype), b, h),
+        _from3(dv_home.astype(v.dtype), b, h),
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ring attention with Pallas flash kernels per visiting shard (call
+    under shard_map; same contract as ``parallel.sequence.ring_attention``:
+    ``[B, L_local, H, D]`` shards of a contiguously-sharded sequence).
+
+    Requires equal-length shards with L_local a multiple of the clamped
+    block sizes; use ``ring_attention`` for anything irregular. Note
+    ``base_offset`` is unsupported (the causal structure is derived from
+    ring positions, which already encode absolute order).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    lq, lk = q.shape[1], k.shape[1]
+    if lq != lk:
+        raise ValueError(
+            f"ring flash needs equal Q/KV shard lengths, got {lq} vs {lk}"
+        )
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"shard length {lq} must be a multiple of the block sizes "
+            f"({block_q}, {block_k}); pad the sequence or use ring_attention"
+        )
+    return _ring_flash(q, k, v, axis, causal, scale, block_q, block_k,
+                       interpret)

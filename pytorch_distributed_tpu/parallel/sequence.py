@@ -20,9 +20,13 @@ TPU-first details:
 - everything lives inside ``shard_map`` and differentiates through scan +
   ppermute, so the same code trains.
 
-The plain ring schedule wastes work for causal masks (fully-masked blocks
-are still computed, ~2x); a zigzag/striped schedule removes that and is a
-planned optimization, noted here so the cost model is honest.
+Causal runs skip fully-masked visiting shards entirely (a KV shard whose
+every key is in the future of every local query contributes nothing — a
+``lax.cond`` keeps the scan structure static while the branch's matmuls
+never execute), recovering ~2x of the plain ring schedule's waste at no
+change in results. The remaining imbalance (later ring positions fold more
+real blocks than earlier ones) is what a zigzag/striped layout would fix;
+noted so the cost model is honest.
 """
 
 from __future__ import annotations
@@ -74,11 +78,23 @@ def ring_attention(
     def fold(state, k_cur, v_cur, step):
         # kv shard currently held originated on device (my - step) mod s
         src = jax.lax.rem(my - step + s, s)
-        return attend_block(
-            state, q, k_cur, v_cur,
-            scale=scale, causal=causal,
-            q_offset=q_offset, k_offset=base_offset + src * lk,
-        )
+
+        def attend(st):
+            return attend_block(
+                st, q, k_cur, v_cur,
+                scale=scale, causal=causal,
+                q_offset=q_offset, k_offset=base_offset + src * lk,
+            )
+
+        if not causal:
+            return attend(state)
+        # Shards are CONTIGUOUS position blocks, so a visiting shard from a
+        # later ring position (src > my) is entirely in every local query's
+        # future: fully masked, contributes nothing — skip its matmuls.
+        # (Equal-length shards ⇒ the block test reduces to src > my.)
+        if lk != lq:
+            return attend(state)  # unequal shards: no block-level shortcut
+        return jax.lax.cond(src > my, lambda st: st, attend, state)
 
     def body(carry, step):
         state, (k_cur, v_cur) = carry

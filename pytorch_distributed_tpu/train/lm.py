@@ -184,20 +184,21 @@ def check_seq_parallel_attention(mesh: Mesh, config, seq_axis: str = SEQ_AXIS):
 
     Under a seq-sharded shard_map, dense/blockwise/flash attention computes
     shard-LOCAL attention — each shard only attends to its own tokens — and
-    trains on wrong math without any error. Only 'ring' goes global. Raise
-    up front instead of producing a subtly broken model.
+    trains on wrong math without any error. Only the ring variants go
+    global. Raise up front instead of producing a subtly broken model.
     """
     if (
         seq_axis in mesh.shape
         and mesh.shape[seq_axis] > 1
-        and getattr(config, "attention", None) != "ring"
+        and getattr(config, "attention", None) not in ("ring", "ring_flash")
     ):
         raise ValueError(
             f"mesh shards the sequence axis {seq_axis!r} "
             f"(size {mesh.shape[seq_axis]}) but config.attention="
             f"{getattr(config, 'attention', None)!r}: non-ring attention is "
             "shard-local under sequence parallelism and computes the wrong "
-            "function. Use attention='ring' (or a seq-axis size of 1)."
+            "function. Use attention='ring'/'ring_flash' (or a seq-axis "
+            "size of 1)."
         )
 
 

@@ -153,8 +153,10 @@ def parse_lm_args(description: str) -> argparse.Namespace:
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--attention", default="flash",
-                   choices=["dense", "blockwise", "flash", "ring"],
-                   help="attention path when the seq axis is unsharded")
+                   choices=["dense", "blockwise", "flash", "ring",
+                            "ring_flash"],
+                   help="attention path (seq-sharded runs default to "
+                        "ring_flash; pass ring for the XLA ring)")
     p.add_argument("--seq-parallel", type=int, default=2,
                    help="sequence-parallel degree (ring attention when > 1)")
     p.add_argument("--model-parallel", type=int, default=1,

@@ -82,8 +82,19 @@ def main() -> None:
     mesh = make_mesh(data_parallel=n // (sp * tp), seq_parallel=sp,
                      model_parallel=tp)
 
+    # seq-sharded runs need a global (ring) attention; honor an explicit
+    # ring variant from --attention, otherwise default to the Pallas-kernel
+    # ring (ops/ring_flash.py — ~2.6x the XLA ring end-to-end, BENCH_LM.md)
+    if sp > 1:
+        attention = (args.attention
+                     if args.attention in ("ring", "ring_flash")
+                     else "ring_flash")
+    else:
+        attention = args.attention
     if args.tiny:
         model_cfg = tiny_config(
+            # tiny exists for CPU smoke runs, where the Pallas kernels
+            # can't compile: pin the XLA paths
             attention="ring" if sp > 1 else "dense",
             model_axis="model" if tp > 1 else None,
             tp_size=tp,
@@ -98,7 +109,7 @@ def main() -> None:
             max_seq_len=seq_len,
             dropout=args.dropout,
             dtype=jnp.bfloat16,
-            attention="ring" if sp > 1 else args.attention,
+            attention=attention,
             model_axis="model" if tp > 1 else None,
             tp_size=tp,
         )

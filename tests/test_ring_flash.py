@@ -1,0 +1,134 @@
+"""Ring attention over the Pallas flash kernels (ops.ring_flash): values
+AND gradients must match dense attention on the gathered sequence — the
+custom_vjp's two-ring-pass backward is the risky part."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.attention import dense_attention
+from pytorch_distributed_tpu.ops.ring_flash import ring_flash_attention
+from pytorch_distributed_tpu.parallel import make_mesh
+from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, shard_map
+
+
+def qkv(b=2, l=64, h=2, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def ring_fn(mesh, causal, block=16):
+    fn = shard_map(
+        functools.partial(ring_flash_attention, causal=causal,
+                          block_q=block, block_k=block, interpret=True),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, SEQ_AXIS),) * 3,
+        out_specs=P(DATA_AXIS, SEQ_AXIS),
+        check_vma=False,
+    )
+    return fn
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_flash_matches_dense(devices8, causal, sp):
+    mesh = make_mesh(devices8[: 2 * sp], data_parallel=2, seq_parallel=sp)
+    q, k, v = qkv()
+    sh = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = ring_fn(mesh, causal)(qs, ks, vs)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_grads_match_dense(devices8, causal):
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=4)
+    q, k, v = qkv()
+    sh = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    fn = ring_fn(mesh, causal)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+    g_r = jax.grad(loss_ring, argnums=(0, 1, 2))(
+        *(jax.device_put(x, sh) for x in (q, k, v))
+    )
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_r, g_d):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=f"d{name}",
+        )
+
+
+def test_ring_flash_single_shard(devices8):
+    """seq axis of size 1: degenerates to plain (causal) flash."""
+    mesh = make_mesh(devices8[:2], data_parallel=2, seq_parallel=1)
+    q, k, v = qkv(l=32)
+    sh = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    out = ring_fn(mesh, True)(*(jax.device_put(x, sh) for x in (q, k, v)))
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_ring_flash_validations():
+    q, k, v = qkv(l=30)
+    with pytest.raises(ValueError, match="multiple"):
+        ring_flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    q2, _, _ = qkv(l=32)
+    with pytest.raises(ValueError, match="equal"):
+        ring_flash_attention(q2, k, v, interpret=True)
+
+
+def test_lm_ring_flash_matches_ring(devices8):
+    """The full TransformerLM with attention='ring_flash' matches the XLA
+    ring path over a dp x sp mesh (interpret-mode kernels on CPU)."""
+    import pytorch_distributed_tpu.ops.ring_flash as rf
+    from pytorch_distributed_tpu.models.transformer import tiny_config
+    from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
+    from pytorch_distributed_tpu.train.lm import (
+        create_lm_state,
+        make_lm_train_step,
+        shard_lm_state,
+        shift_labels,
+    )
+
+    def run(attention):
+        mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+        cfg = tiny_config(attention=attention)
+        tx = sgd_with_weight_decay(0.1, momentum=0.9)
+        state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+        state, specs = shard_lm_state(mesh, state, cfg)
+        step = make_lm_train_step(mesh, state_specs=specs, config=cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(1, 128, (4, 32)).astype(np.int32)
+        labels, weights = shift_labels(tokens)
+        sh = NamedSharding(mesh, P("data", "seq"))
+        batch = {"tokens": jax.device_put(tokens, sh),
+                 "labels": jax.device_put(labels, sh),
+                 "weights": jax.device_put(weights, sh)}
+        losses = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    orig = rf.ring_flash_attention
+    try:
+        rf.ring_flash_attention = functools.partial(orig, interpret=True)
+        losses_rf = run("ring_flash")
+    finally:
+        rf.ring_flash_attention = orig
+    losses_ring = run("ring")
+    np.testing.assert_allclose(losses_rf, losses_ring, rtol=2e-4)
