@@ -21,11 +21,15 @@ over-capacity tokens fall through to the residual path. The Switch
 load-balancing auxiliary loss is sowed (pre-weighted) into the
 ``aux_loss`` collection; the LM step collects and adds it.
 
-Interaction with tensor parallelism: MoE blocks do NOT partition over the
-model axis — under TP every model rank computes the full expert MLP
-redundantly (replicated activations in, replicated out, identical grads).
-Correct, but TP buys no FLOPs in MoE layers; partitioning the expert hidden
-dim over the model axis is the planned follow-up.
+Interaction with tensor parallelism: with ``model_axis``/``tp_size`` set,
+the expert HIDDEN dim partitions over the model axis (Megatron column/row
+split inside each expert: ``w_up`` is column-parallel, ``w_down``
+row-parallel with one psum) — TP buys real FLOPs in MoE blocks. Router,
+dispatch, and the capacity buffers stay replicated across the model axis
+(every TP rank routes identically), so the all_to_all expert exchange is
+unchanged. With ``model_axis=None`` (default) every model rank computes
+the full expert MLP redundantly — correct, just wasteful, kept for
+mesh-without-TP layouts.
 """
 
 from __future__ import annotations
@@ -115,6 +119,8 @@ class MoEMLP(nn.Module):
     top_k: int = 1
     ep_size: int = 1
     expert_axis: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -123,6 +129,12 @@ class MoEMLP(nn.Module):
         t = b * l
         e = self.n_experts
         e_local = e // self.ep_size
+        f_local = self.mlp_dim // self.tp_size
+        if self.mlp_dim % self.tp_size:
+            raise ValueError(
+                f"mlp_dim {self.mlp_dim} not divisible by tp_size "
+                f"{self.tp_size}"
+            )
         x_flat = x.reshape(t, d)
 
         router = nn.Dense(e, use_bias=False, dtype=jnp.float32, name="router")
@@ -137,15 +149,18 @@ class MoEMLP(nn.Module):
         # the mean over layers/shards as metrics["moe_dropped_frac"].
         self.sow("moe_stats", "dropped_frac", stats["dropped_frac"])
 
+        # Parameters keep GLOBAL shapes (placement shards them: expert dim
+        # over the data axis for EP, hidden dim over the model axis for
+        # TP); under shard_map flax sees the LOCAL slices.
         w_up = self.param(
             "w_up",
             nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
-            (e_local, d, self.mlp_dim),
+            (e_local, d, f_local),
         )
         w_down = self.param(
             "w_down",
             nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
-            (e_local, self.mlp_dim, d),
+            (e_local, f_local, d),
         )
 
         # [T, E, C] × [T, D] → per-expert buffers [E, C, D]
@@ -165,9 +180,23 @@ class MoEMLP(nn.Module):
         else:
             xe = expert_in  # [E(=E_local), C, D]
 
+        # Megatron split inside each expert: w_up column-parallel (local
+        # hidden slice), w_down row-parallel — the partial outputs sum over
+        # the model axis with ONE psum. The f/g custom-VJP pair keeps the
+        # backward exact: tp_copy (identity fwd, psum bwd) guards the
+        # replicated input of the column-parallel matmul, tp_reduce (psum
+        # fwd, identity bwd) combines the row-parallel partials.
+        if self.model_axis and self.tp_size > 1:
+            from pytorch_distributed_tpu.parallel.tensor import tp_copy
+
+            xe = tp_copy(xe, self.model_axis)
         h = jnp.einsum("ecd,edf->ecf", xe, w_up.astype(self.dtype))
         h = nn.gelu(h)
         ye = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
+        if self.model_axis and self.tp_size > 1:
+            from pytorch_distributed_tpu.parallel.tensor import tp_reduce
+
+            ye = tp_reduce(ye, self.model_axis)
 
         if self.expert_axis and self.ep_size > 1:
             ye = ye.reshape(e_local, self.ep_size, capacity, d)

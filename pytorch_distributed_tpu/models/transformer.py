@@ -83,6 +83,12 @@ class TransformerConfig:
             raise ValueError(
                 f"num_heads {self.num_heads} not divisible by tp_size {self.tp_size}"
             )
+        if self.tp_size > 1 and self.model_axis is None:
+            raise ValueError(
+                f"tp_size {self.tp_size} > 1 requires model_axis: without "
+                "the axis name the TP collectives are skipped and the model "
+                "silently trains with thin local shards"
+            )
         if (self.embed_dim * self.mlp_ratio) % self.tp_size:
             raise ValueError(
                 f"mlp width {self.embed_dim * self.mlp_ratio} not divisible "
@@ -129,12 +135,25 @@ class Attention(nn.Module):
             # Same ring schedule, Pallas flash kernels per visiting shard
             # (ops/ring_flash.py). Causal structure comes from ring
             # positions, which is exact for any uniform position offset.
-            # Blocks must DIVIDE the shard length (the kernel has no pad
-            # path under the ring); take the largest divisor within the
-            # configured block size so any length works.
-            blk = min(cfg.block_size, l)
-            while l % blk:
-                blk -= 1
+            # Blocks must DIVIDE the shard length (no pad path under the
+            # ring) and should stay lane-aligned: prefer the largest
+            # 128-multiple divisor within block_size; small shards run as
+            # one block; anything else (e.g. L_local=250) is rejected
+            # rather than silently degenerating to tiny unaligned blocks.
+            limit = min(cfg.block_size, l)
+            blk = max(
+                (c for c in range(128, limit + 1, 128) if l % c == 0),
+                default=None,
+            )
+            if blk is None and l <= limit and (l < 128 or l % 8 == 0):
+                blk = l  # single-block shard (small/test shapes)
+            if blk is None:
+                raise ValueError(
+                    f"ring_flash: no usable block size for shard length {l} "
+                    f"(block_size {cfg.block_size}); pad the sequence so "
+                    "L/seq_parallel has a 128-multiple divisor, or use "
+                    "attention='ring'"
+                )
             out = ring_flash_attention(
                 q, k, v, axis=cfg.seq_axis, causal=True,
                 block_q=blk, block_k=blk,
@@ -201,6 +220,8 @@ class Block(nn.Module):
                 top_k=cfg.moe_top_k,
                 ep_size=cfg.ep_size,
                 expert_axis=cfg.expert_axis,
+                tp_size=cfg.tp_size,
+                model_axis=cfg.model_axis,
                 dtype=cfg.dtype,
                 name="moe",
             )(h)

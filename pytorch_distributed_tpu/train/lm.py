@@ -97,11 +97,28 @@ TRANSFORMER_TP_RULES = (
     (r"mlp_down/kernel", P(MODEL_AXIS, None)),  # [4E,E] → 4E
 )
 
-# MoE expert weights: sharded on the expert dim over the DATA axis (GShard
-# expert parallelism; models/moe.py). Only applied when the config actually
-# runs expert-parallel (ep_size == data-axis size) — with ep_size=1 the
-# experts must stay replicated or the module's declared shapes mismatch.
-MOE_EP_RULE = (r"moe/w_(up|down)", P(DATA_AXIS))
+# MoE expert weights shard on TWO independent axes (models/moe.py): the
+# expert dim over the DATA axis (GShard expert parallelism, when
+# ep_size == data-axis size) and the expert HIDDEN dim over the MODEL axis
+# (Megatron split inside each expert, when tp_size > 1). Rules are built
+# per-config in lm_state_specs since both placements are conditional.
+
+
+def _moe_rules(config):
+    ep = (
+        config.expert_axis
+        if config.expert_axis is not None and config.ep_size > 1
+        else None
+    )
+    tp = (
+        config.model_axis
+        if config.model_axis is not None and config.tp_size > 1
+        else None
+    )
+    return (
+        (r"moe/w_up", P(ep, None, tp)),  # [E, D, F]
+        (r"moe/w_down", P(ep, tp, None)),  # [E, F, D]
+    )
 
 
 def _has_moe_params(params) -> bool:
@@ -134,10 +151,9 @@ def lm_state_specs(state: TrainState, rules=None, config=None) -> TrainState:
                 raise ValueError(
                     "state contains MoE expert weights; pass the "
                     "TransformerConfig so their placement (ep_size/"
-                    "expert_axis) is known"
+                    "expert_axis/tp_size) is known"
                 )
-            if config.expert_axis is not None and config.ep_size > 1:
-                rules = rules + (MOE_EP_RULE,)
+            rules = rules + _moe_rules(config)
     param_specs = match_partition_rules(rules, state.params)
     return state.replace(
         step=P(),

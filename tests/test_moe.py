@@ -130,7 +130,7 @@ def test_expert_weights_sharded_over_data(devices8):
     w_up = state.params["block1"]["moe"]["w_up"]  # block1 is the MoE block
     shapes = {s.data.shape for s in w_up.addressable_shards}
     assert shapes == {(1, 32, 128)}  # 4 experts / 4 data ranks
-    assert specs.params["block1"]["moe"]["w_up"] == P("data")
+    assert specs.params["block1"]["moe"]["w_up"] == P("data", None, None)
 
 
 def test_moe_replicated_experts_on_dp_mesh(devices8):
@@ -278,3 +278,49 @@ def test_moe_dropped_frac_nonzero_when_capacity_tight(devices8):
              "weights": jax.device_put(weights, sh)}
     _, m = step_fn(state, batch)
     assert 0.0 < float(m["moe_dropped_frac"]) < 1.0
+
+
+def test_moe_tp_hidden_dim_sharding_matches_single_device(devices8):
+    """MoE hidden dim partitioned over the model axis (Megatron split
+    inside each expert, composed with EP over data and ring attention over
+    seq): a dp2 x sp2 x tp2 MoE LM matches single-device training, and the
+    expert weights really shard on BOTH axes."""
+    mesh_3d = make_mesh(devices8, data_parallel=2, seq_parallel=2,
+                        model_parallel=2)
+    mesh_1 = make_mesh(devices8[:1])
+
+    def run(mesh, tp, ep):
+        cfg = tiny_config(
+            attention="ring" if mesh.shape["seq"] > 1 else "dense",
+            model_axis="model" if tp > 1 else None, tp_size=tp,
+            n_experts=4, moe_every=2,
+            capacity_factor=float(4 * 8), moe_aux_weight=0.0,
+            expert_axis="data" if ep > 1 else None, ep_size=ep,
+        )
+        tx = sgd_with_weight_decay(0.1, momentum=0.9)
+        state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+        state, specs = shard_lm_state(mesh, state, cfg)
+        step_fn = make_lm_train_step(mesh, state_specs=specs, config=cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(1, 128, (4, 32)).astype(np.int32)
+        labels, weights = shift_labels(tokens)
+        sh = NamedSharding(mesh, P("data", "seq"))
+        batch = {"tokens": jax.device_put(tokens, sh),
+                 "labels": jax.device_put(labels, sh),
+                 "weights": jax.device_put(weights, sh)}
+        losses = []
+        for _ in range(3):
+            state, m = step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return state, specs, losses
+
+    state_3d, specs, losses_3d = run(mesh_3d, tp=2, ep=2)
+    _, _, losses_1 = run(mesh_1, tp=1, ep=1)
+    np.testing.assert_allclose(losses_3d, losses_1, rtol=5e-4)
+    # both axes really shard: [E=4, D=32, F=128] -> local (2, 32, 64)
+    w_up = state_3d.params["block1"]["moe"]["w_up"]
+    assert specs.params["block1"]["moe"]["w_up"] == P("data", None, "model")
+    assert {s.data.shape for s in w_up.addressable_shards} == {(2, 32, 64)}
+    w_down = state_3d.params["block1"]["moe"]["w_down"]
+    assert specs.params["block1"]["moe"]["w_down"] == P("data", "model", None)
+    assert {s.data.shape for s in w_down.addressable_shards} == {(2, 64, 32)}
