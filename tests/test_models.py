@@ -67,3 +67,31 @@ def test_bf16_compute_keeps_fp32_params_and_logits():
 )
 def test_family_stage_sizes(builder, expected_blocks):
     assert tuple(builder().stage_sizes) == expected_blocks
+
+
+def test_space_to_depth_stem_is_exact():
+    """SpaceToDepthStem computes the IDENTICAL function to the 7x7/2 stem
+    from the same canonical [7,7,3,F] weights (values and grads) — the
+    MLPerf input transform as a checkpoint-compatible model option."""
+    import numpy as np
+
+    from pytorch_distributed_tpu.models.resnet import BottleneckBlock, ResNet
+
+    m_std = ResNet(stage_sizes=(1, 1), block_cls=BottleneckBlock, num_classes=10)
+    m_s2d = ResNet(stage_sizes=(1, 1), block_cls=BottleneckBlock,
+                   num_classes=10, space_to_depth_stem=True)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 64, 3)),
+                    jnp.float32)
+    v = m_std.init(jax.random.key(0), x, train=False)
+    assert jax.tree.structure(v) == jax.tree.structure(
+        m_s2d.init(jax.random.key(0), x, train=False)
+    )
+    y1 = m_std.apply(v, x, train=False)
+    y2 = m_s2d.apply(v, x, train=False)  # SAME weights
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-5,
+                               atol=1e-5)
+    g1 = jax.grad(lambda v: jnp.sum(m_std.apply(v, x, train=False) ** 2))(v)
+    g2 = jax.grad(lambda v: jnp.sum(m_s2d.apply(v, x, train=False) ** 2))(v)
+    from conftest import assert_trees_equal
+
+    assert_trees_equal(g1["params"], g2["params"], rtol=2e-4, atol=2e-5)
