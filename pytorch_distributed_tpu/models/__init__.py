@@ -1,3 +1,4 @@
+from pytorch_distributed_tpu.models.generate import generate
 from pytorch_distributed_tpu.models.transformer import (
     TransformerConfig,
     TransformerLM,
@@ -13,6 +14,7 @@ from pytorch_distributed_tpu.models.resnet import (
 )
 
 __all__ = [
+    "generate",
     "TransformerConfig",
     "TransformerLM",
     "tiny_config",

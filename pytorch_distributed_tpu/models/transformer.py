@@ -101,6 +101,8 @@ class TransformerConfig:
 class Attention(nn.Module):
     config: TransformerConfig
     deterministic: bool = True
+    decode: bool = False
+    prefill: bool = False
 
     @nn.compact
     def __call__(self, x, position_offset):
@@ -116,6 +118,56 @@ class Attention(nn.Module):
             (3, heads_local, head_dim), dtype=cfg.dtype, name="qkv"
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H_loc, D]
+
+        if self.decode or self.prefill:
+            # KV cache. ``position_offset`` is the single source of
+            # position truth — the write index, the attention mask, AND
+            # the positional embedding all derive from it, so they cannot
+            # silently disagree (no per-layer counter to drift).
+            max_len = cfg.max_seq_len
+            ck = self.variable(
+                "cache", "key",
+                lambda: jnp.zeros((b, max_len, heads_local, head_dim), cfg.dtype),
+            )
+            cv = self.variable(
+                "cache", "value",
+                lambda: jnp.zeros((b, max_len, heads_local, head_dim), cfg.dtype),
+            )
+            pos = jnp.asarray(position_offset, jnp.int32)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, pos, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, pos, 0, 0)
+            )
+
+        if self.decode:
+            # Single-token step attending against the cache (O(L) per
+            # token); parity vs the full causal forward is tested in
+            # tests/test_generate.py.
+            assert l == 1, f"decode mode processes one token/step, got {l}"
+            pos = jnp.asarray(position_offset, jnp.int32)
+            scale = head_dim**-0.5
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                ck.value.astype(jnp.float32),
+            )  # [B, H, 1, max_len]
+            mask = jnp.arange(cfg.max_seq_len)[None, None, None, :] <= pos
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum(
+                "bhqk,bkhd->bqhd", p, cv.value.astype(jnp.float32)
+            ).astype(cfg.dtype)
+            out = nn.DenseGeneral(
+                e, axis=(-2, -1), use_bias=False, dtype=cfg.dtype, name="proj"
+            )(out)
+            if cfg.model_axis:
+                from pytorch_distributed_tpu.parallel.tensor import tp_reduce
+
+                out = tp_reduce(out, cfg.model_axis)
+            return out
+        # prefill falls through: one BATCHED causal forward over the prompt
+        # (the cache write above is its only side effect)
 
         if cfg.attention == "ring":
             from pytorch_distributed_tpu.parallel.sequence import ring_attention
@@ -200,14 +252,17 @@ class Block(nn.Module):
     config: TransformerConfig
     use_moe: bool = False
     deterministic: bool = True
+    decode: bool = False
+    prefill: bool = False
 
     @nn.compact
     def __call__(self, x, position_offset):
         cfg = self.config
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + Attention(cfg, deterministic=self.deterministic, name="attn")(
-            h, position_offset
-        )
+        x = x + Attention(
+            cfg, deterministic=self.deterministic, decode=self.decode,
+            prefill=self.prefill, name="attn",
+        )(h, position_offset)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.use_moe:
             from pytorch_distributed_tpu.models.moe import MoEMLP
@@ -257,22 +312,25 @@ class TransformerLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, position_offset: jax.Array | int = 0, train: bool = True):
+    def __call__(self, tokens, position_offset: jax.Array | int = 0,
+                 train: bool = True, decode: bool = False,
+                 prefill: bool = False):
         cfg = self.config
         # Dropout is active only when train=True AND an rng is provided
         # (apply(..., rngs={"dropout": key}) — train/lm.py derives the key
         # from (seed, step, shard coords) so resumed runs are bit-identical).
-        deterministic = not (train and cfg.dropout > 0.0)
+        inference = decode or prefill
+        deterministic = not (train and cfg.dropout > 0.0) or inference
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype, name="wte")(tokens)
         pos = position_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype, name="wpe")(pos)
-        if cfg.dropout:
+        if cfg.dropout and not inference:
             x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
         for i in range(cfg.num_layers):
             use_moe = bool(cfg.n_experts) and (i % cfg.moe_every == cfg.moe_every - 1)
             x = Block(
                 cfg, use_moe=use_moe, deterministic=deterministic,
-                name=f"block{i}",
+                decode=decode, prefill=prefill, name=f"block{i}",
             )(x, position_offset)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
