@@ -85,6 +85,16 @@ class DistributedSampler:
     def __len__(self) -> int:
         return self.num_samples
 
+    def local_padding_mask(self) -> np.ndarray:
+        """Boolean [num_samples]: True where this replica's position holds a
+        wrap-padding duplicate (torch's non-drop_last padding repeats
+        indices from the front to reach a ``num_replicas``-divisible
+        total). Torch counts those duplicates in val metrics; metric code
+        here can zero their weight instead so psum'd reductions aren't
+        biased when the val size isn't divisible by the replica count."""
+        global_pos = np.arange(self.rank, self.total_size, self.num_replicas)
+        return global_pos >= self.dataset_size
+
     def iter_from(self, start_index: int):
         """Seekable iteration: skip the first ``start_index`` samples without
         touching the dataset (replaces the reference's read-and-discard

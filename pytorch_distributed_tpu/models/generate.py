@@ -86,6 +86,26 @@ def generate(
             f"prompt ({l_prompt}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds max_seq_len {config.max_seq_len}"
         )
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and not 1 <= top_k <= config.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={config.vocab_size}], "
+            f"got {top_k}"
+        )
+    if getattr(config, "attention", "dense") in ("ring", "ring_flash"):
+        raise ValueError(
+            "generate() is dense-attention only (the KV cache IS the "
+            "global sequence); build the decode config with "
+            "attention='dense' — ring/ring_flash are training-time "
+            "sequence-parallel layouts"
+        )
+    if config.model_axis is not None:
+        raise ValueError(
+            "generate() runs replicated — clear model_axis/tp_size on the "
+            "decode config (checkpoints are interchangeable across tp "
+            "degrees, so TP-trained params load into the replicated config)"
+        )
 
     # Prefill: one batched causal forward writes the whole prompt's K/V
     # into the (freshly initialized) cache and yields the last logits.

@@ -195,7 +195,10 @@ def _bwd_dq_kernel(
                 jnp.int32, (block_q, block_k), 0
             )
             mask = mask & (k_pos <= q_pos)
-        p = jnp.exp(s - lse) * mask  # [block_q, block_k], fp32
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [block_q, block_k], fp32
+        # where (not *) so a fully-masked row (lse = -inf from the
+        # forward) yields 0, not inf*0 = NaN — defends offset/cross-
+        # attention callers the forward already defends.
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -251,7 +254,7 @@ def _bwd_dkv_kernel(
                 jnp.int32, (block_q, block_k), 0
             )
             mask = mask & (k_pos <= q_pos)
-        p = jnp.exp(s - lse) * mask
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # see dq kernel note
         # dV += P^T dO
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),

@@ -363,12 +363,18 @@ def make_lm_eval_step(
     shard (and host) carries the global totals — the reference's
     reduce-to-0 superset, same as the image eval step.
 
-    MoE configs evaluate DROPLESS (capacity_factor raised to n_experts so
-    no token ever hits a full expert): under tight train-time capacity, the
-    routing a token gets depends on which other rows share its batch —
-    zero-weight padding rows could displace real tokens' routes and make
-    reported perplexity vary with the val-set padding. Dropless eval is
-    deterministic per token and standard practice.
+    MoE configs evaluate with RELAXED capacity (4× the train
+    capacity_factor, clamped to n_experts): under tight train-time
+    capacity, the routing a token gets depends on which other rows share
+    its batch — zero-weight padding rows could displace real tokens'
+    routes and make reported perplexity vary with the val-set padding.
+    True dropless eval (capacity_factor = n_experts ⇒ capacity = k·T)
+    would make the one-hot [T, E, C] dispatch tensors quadratic in local
+    token count — terabytes at recipe defaults — so the bound is a modest
+    multiple instead: at 4× the expected per-expert load, displacement of
+    a real token requires an 4×-overloaded expert, which top-k routing on
+    a trained router essentially never produces; routing is
+    near-deterministic while dispatch stays O(T·E·C) with C ≪ T.
     """
     if config is not None:
         check_seq_parallel_attention(mesh, config, seq_axis)
@@ -379,9 +385,8 @@ def make_lm_eval_step(
 
         from pytorch_distributed_tpu.models.transformer import TransformerLM
 
-        eval_cfg = dataclasses.replace(
-            config, capacity_factor=float(config.n_experts)
-        )
+        eval_cf = min(4.0 * config.capacity_factor, float(config.n_experts))
+        eval_cfg = dataclasses.replace(config, capacity_factor=eval_cf)
         eval_apply = TransformerLM(eval_cfg).apply
 
     def _local_eval(state: TrainState, batch: dict, acc: dict):

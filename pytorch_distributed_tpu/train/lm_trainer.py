@@ -208,8 +208,19 @@ class LMTrainer(SuspendableTrainer):
         acc = jax.device_put(
             empty_lm_metrics(), mesh_lib.replicated_sharding(self.mesh)
         )
-        for host_batch in self.val_loader.iter_batches(0):
+        wrap_pad = self.val_sampler.local_padding_mask()
+        for b, host_batch in enumerate(self.val_loader.iter_batches(0)):
             n = host_batch["tokens"].shape[0]
+            # Zero the weight of wrap-padded duplicates (uneven
+            # process splits repeat indices, torch-style) so the psum'd
+            # loss_sum/tokens count each real sequence exactly once —
+            # unbiased perplexity, unlike torch's duplicate counting.
+            rows = wrap_pad[b * self._local_batch : b * self._local_batch + n]
+            if rows.any():
+                host_batch = dict(host_batch)
+                host_batch["weights"] = (
+                    host_batch["weights"] * ~rows[:, None]
+                ).astype(np.float32)
             pad = self._local_batch - n
             if pad:
                 # zero-weight padding rows keep the compiled batch shape

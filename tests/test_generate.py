@@ -86,3 +86,34 @@ def test_empty_prompt_raises():
     cfg, model, params, _ = setup()
     with pytest.raises(ValueError, match="at least one"):
         generate(cfg, params, jnp.zeros((2, 0), jnp.int32), jax.random.key(0))
+
+
+def test_invalid_sampling_params_raise():
+    """top_k out of [1, vocab_size] and negative temperature fail up front
+    with clear messages, not opaque trace-time errors."""
+    cfg, model, params, tokens = setup()
+    key = jax.random.key(0)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(cfg, params, tokens, key, max_new_tokens=4,
+                 temperature=1.0, top_k=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(cfg, params, tokens, key, max_new_tokens=4,
+                 temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(cfg, params, tokens, key, max_new_tokens=4,
+                 temperature=-0.5)
+
+
+def test_parallel_configs_rejected_up_front():
+    """Ring attention and TP configs are documented unsupported in
+    generate(); they must fail immediately, not with an unbound-axis error
+    deep inside apply."""
+    import dataclasses
+
+    cfg, model, params, tokens = setup()
+    ring = dataclasses.replace(cfg, attention="ring")
+    with pytest.raises(ValueError, match="dense-attention only"):
+        generate(ring, params, tokens, jax.random.key(0), max_new_tokens=4)
+    tp = dataclasses.replace(cfg, model_axis="model", tp_size=2)
+    with pytest.raises(ValueError, match="replicated"):
+        generate(tp, params, tokens, jax.random.key(0), max_new_tokens=4)

@@ -80,3 +80,32 @@ def test_iter_from_seeks_without_io():
 def test_rank_validation():
     with pytest.raises(ValueError):
         DistributedSampler(10, 4, 4)
+
+
+def test_local_padding_mask_marks_wrapped_duplicates():
+    """The wrap-padding positions (torch repeats indices to reach a
+    divisible total) are exactly the ones the mask flags, on every rank;
+    unpadded and drop_last samplers have all-False masks."""
+    from pytorch_distributed_tpu.data.sampler import DistributedSampler
+
+    size, replicas = 10, 4  # total_size 12, 2 padded positions
+    seen = []
+    for rank in range(replicas):
+        s = DistributedSampler(size, replicas, rank, shuffle=True, seed=3)
+        mask = s.local_padding_mask()
+        idx = s.local_indices()
+        assert mask.shape == idx.shape
+        seen.append((idx, mask))
+    total_pad = sum(m.sum() for _, m in seen)
+    assert total_pad == 2
+    # every dataset index appears exactly once among unpadded positions
+    real = np.concatenate([i[~m] for i, m in seen])
+    assert sorted(real.tolist()) == list(range(size))
+    # padded positions duplicate indices that already appear unpadded
+    dup = np.concatenate([i[m] for i, m in seen])
+    assert set(dup.tolist()) <= set(real.tolist())
+
+    even = DistributedSampler(12, 4, 0)
+    assert not even.local_padding_mask().any()
+    dropped = DistributedSampler(10, 4, 1, drop_last=True)
+    assert not dropped.local_padding_mask().any()
