@@ -84,10 +84,16 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        # Explicit (1,1) padding = torchvision's padding=1: identical to
+        # SAME at stride 1, but at stride 2 SAME pads (0,1) and shifts the
+        # conv windows one pixel off torch's — exact-parity blocker.
+        y = self.conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            padding=[(1, 1), (1, 1)],
+        )(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
         y = self.norm()(y)
         if residual.shape != y.shape:
             if self.pointwise is not None:
@@ -134,9 +140,12 @@ class BottleneckBlock(nn.Module):
         y = conv1x1(self.filters, name="Conv_0")(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="Conv_1")(
-            y
-        )
+        # padding=1 like torchvision: SAME would pad (0,1) at stride 2 and
+        # shift windows one pixel off torch's (see BasicBlock note).
+        y = self.conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            padding=[(1, 1), (1, 1)], name="Conv_1",
+        )(y)
         y = self.norm()(y)
         y = nn.relu(y)
         y = conv1x1(self.filters * self.expansion, name="Conv_2")(y)

@@ -1,0 +1,179 @@
+"""Numerical parity with torchvision ResNets — the reference's correctness
+bar is torchvision resnet50 top-1/top-5 on ImageNet (restnet_ddp.py:58-70);
+the honest proxy available without an ImageNet run is that torchvision
+weights imported into models/resnet.py produce the same logits, the same
+train-mode batch statistics, and the same SGD loss trajectory as torch on
+identical data.
+
+Torch models are randomly initialized (zero-egress environment: pretrained
+downloads are unavailable) — the mapping under test is purely structural,
+so random weights prove it just as well.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pytorch_distributed_tpu.models.resnet import (  # noqa: E402
+    resnet18,
+    resnet50,
+)
+from pytorch_distributed_tpu.models.torch_import import (  # noqa: E402
+    export_resnet_state,
+    import_resnet_state,
+)
+import torch_resnet_ref  # noqa: E402
+
+
+def _batch(rng, b=2, hw=64):
+    x = rng.standard_normal((b, 3, hw, hw)).astype(np.float32)
+    return torch.from_numpy(x), jnp.asarray(x.transpose(0, 2, 3, 1))
+
+
+def _import(tmodel, stage_sizes, bottleneck):
+    return import_resnet_state(tmodel.state_dict(), stage_sizes, bottleneck)
+
+
+@pytest.mark.parametrize(
+    "tv_name,builder,stages,bottleneck",
+    [
+        ("resnet18", resnet18, (2, 2, 2, 2), False),
+        ("resnet50", resnet50, (3, 4, 6, 3), True),
+    ],
+)
+def test_eval_logits_match_torch(tv_name, builder, stages, bottleneck):
+    """Same weights + same input ⇒ same logits (running-stats eval mode)."""
+    torch.manual_seed(0)
+    tmodel = getattr(torch_resnet_ref, tv_name)().eval()
+    variables = _import(tmodel, stages, bottleneck)
+    xt, xj = _batch(np.random.default_rng(1))
+
+    with torch.no_grad():
+        ref = tmodel(xt).numpy()
+    got = np.asarray(builder().apply(variables, xj, train=False))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_train_mode_batch_stats_match_torch():
+    """Train-mode forward uses batch statistics; logits AND the updated
+    running mean/var must match torch's momentum-0.1 update."""
+    torch.manual_seed(1)
+    tmodel = torch_resnet_ref.resnet18().train()
+    variables = _import(tmodel, (2, 2, 2, 2), False)
+    xt, xj = _batch(np.random.default_rng(2))
+
+    with torch.no_grad():
+        ref = tmodel(xt).numpy()  # also updates torch running stats
+    got, mutated = resnet18().apply(
+        variables, xj, train=True, mutable=["batch_stats"]
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+    # bn1 running stats after one train-mode forward
+    np.testing.assert_allclose(
+        np.asarray(mutated["batch_stats"]["bn_init"]["mean"]),
+        tmodel.bn1.running_mean.numpy(),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(mutated["batch_stats"]["bn_init"]["var"]),
+        tmodel.bn1.running_var.numpy(),
+        rtol=1e-3,
+        atol=1e-5,
+    )
+
+
+def test_export_roundtrip_bit_exact():
+    torch.manual_seed(2)
+    tmodel = torch_resnet_ref.resnet18()
+    variables = _import(tmodel, (2, 2, 2, 2), False)
+    sd = export_resnet_state(variables, bottleneck=False)
+    again = import_resnet_state(sd, (2, 2, 2, 2), bottleneck=False)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        variables,
+        again,
+    )
+    # and the exported dict loads cleanly into torch (strict: all keys map,
+    # num_batches_tracked excepted — flax has no equivalent counter)
+    missing, unexpected = tmodel.load_state_dict(
+        {k: torch.from_numpy(v) for k, v in sd.items()}, strict=False
+    )
+    assert not unexpected
+    assert all(k.endswith("num_batches_tracked") for k in missing)
+
+
+def test_sgd_loss_trajectory_matches_torch():
+    """Identical init + identical batches + the same SGD(momentum, wd) rule
+    ⇒ the same loss trajectory, through batch-norm train mode and all."""
+    from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+    from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
+
+    torch.manual_seed(3)
+    tmodel = torch_resnet_ref.resnet18(num_classes=10).train()
+    variables = _import(tmodel, (2, 2, 2, 2), False)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    lr, mom, wd = 0.001, 0.9, 1e-4
+    opt = torch.optim.SGD(tmodel.parameters(), lr=lr, momentum=mom,
+                          weight_decay=wd)
+    crit = torch.nn.CrossEntropyLoss()
+
+    tx = sgd_with_weight_decay(lr, momentum=mom, weight_decay=wd)
+    opt_state = tx.init(params)
+    model = resnet18(num_classes=10)
+
+    @jax.jit
+    def step(params, stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": stats}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            return cross_entropy_loss(logits, y), mut["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax_apply(params, updates), new_stats, opt_state, loss
+
+    import optax
+
+    def optax_apply(p, u):
+        return optax.apply_updates(p, u)
+
+    # batch 16, not smaller: BatchNorm over a tiny batch amplifies fp32
+    # backend noise ~40x per step (measured at batch 4), swamping the
+    # comparison; at 16 the trajectories stay locked to ~1e-3.
+    rng = np.random.default_rng(4)
+    torch_losses, jax_losses = [], []
+    for _ in range(4):
+        x = rng.standard_normal((16, 3, 32, 32)).astype(np.float32)
+        y = rng.integers(0, 10, 16)
+
+        opt.zero_grad()
+        out = tmodel(torch.from_numpy(x))
+        tl = crit(out, torch.from_numpy(y))
+        tl.backward()
+        opt.step()
+        torch_losses.append(float(tl))
+
+        params, stats, opt_state, jl = step(
+            params, stats, opt_state,
+            jnp.asarray(x.transpose(0, 2, 3, 1)), jnp.asarray(y),
+        )
+        jax_losses.append(float(jl))
+
+    # Step 0 is the parity proof proper: identical weights and data, one
+    # forward+backward through BN train mode — fp32 backend noise only.
+    assert abs(jax_losses[0] - torch_losses[0]) < 1e-5
+    # The remaining steps compound conv-backward fp noise through the
+    # optimizer (different fp32 conv kernels on each side); the math being
+    # identical keeps the trajectories within a few 1e-3.
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=5e-3, atol=5e-3)

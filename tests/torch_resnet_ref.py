@@ -1,0 +1,113 @@
+"""Minimal torch ResNet with torchvision-compatible state_dict naming.
+
+torchvision is not installed in this environment (zero egress), but the
+parity tests need a live torch model whose ``state_dict`` uses the exact
+naming contract ``models.torch_import`` translates (conv1 / bn1 /
+layerL.B.convN / downsample.0/1 / fc). This is the ResNet v1.5
+architecture written from the paper + the reference's usage
+(``/root/reference/restnet_ddp.py:98`` uses ``torchvision.models.resnet50``):
+7x7/2 stem, 3x3/2 maxpool, four stages, stride on the 3x3 conv of the
+bottleneck (the v1.5 torchvision ships), adaptive average pool, linear
+head. Kaiming fan-out init like torchvision. Not a copy of torchvision
+source — only the public module-naming contract is reproduced, because
+that contract is what the importer under test must understand.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch import nn
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, cin, filters, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, filters, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(filters)
+        self.relu = nn.ReLU(inplace=True)
+        self.conv2 = nn.Conv2d(filters, filters, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(filters)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(y + identity)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, filters, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, filters, 1, 1, 0, bias=False)
+        self.bn1 = nn.BatchNorm2d(filters)
+        self.conv2 = nn.Conv2d(filters, filters, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(filters)
+        self.conv3 = nn.Conv2d(filters, filters * 4, 1, 1, 0, bias=False)
+        self.bn3 = nn.BatchNorm2d(filters * 4)
+        self.relu = nn.ReLU(inplace=True)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(y + identity)
+
+
+class ResNet(nn.Module):
+    def __init__(self, block, stage_sizes, num_classes=1000):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.relu = nn.ReLU(inplace=True)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        cin = 64
+        for i, n in enumerate(stage_sizes):
+            filters, stride = 64 * 2**i, (1 if i == 0 else 2)
+            blocks = []
+            for j in range(n):
+                s = stride if j == 0 else 1
+                down = None
+                if s != 1 or cin != filters * block.expansion:
+                    down = nn.Sequential(
+                        nn.Conv2d(cin, filters * block.expansion, 1, s,
+                                  bias=False),
+                        nn.BatchNorm2d(filters * block.expansion),
+                    )
+                blocks.append(block(cin, filters, s, down))
+                cin = filters * block.expansion
+            setattr(self, f"layer{i + 1}", nn.Sequential(*blocks))
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(cin, num_classes)
+        for m in self.modules():
+            if isinstance(m, nn.Conv2d):
+                nn.init.kaiming_normal_(m.weight, mode="fan_out",
+                                        nonlinearity="relu")
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        for i in range(1, 5):
+            layer = getattr(self, f"layer{i}", None)
+            if layer is None:
+                break
+            x = layer(x)
+        x = torch.flatten(self.avgpool(x), 1)
+        return self.fc(x)
+
+
+def resnet18(num_classes=1000):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes)
+
+
+def resnet50(num_classes=1000):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes)
