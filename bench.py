@@ -65,7 +65,8 @@ def measure_roundtrip_s(n: int = 3) -> float:
     return best
 
 
-def build(batch_size: int, tiny: bool, dtype=jnp.bfloat16, mesh=None):
+def build(batch_size: int, tiny: bool, dtype=jnp.bfloat16, mesh=None,
+          fused: bool = False):
     """State/step/batch for a bench run. ``batch_size`` is the GLOBAL batch
     (sharded over the mesh's data axis; a 1-device mesh makes it per-chip).
     ``mesh`` defaults to one device; scripts/bench_table.py passes multi-
@@ -86,7 +87,7 @@ def build(batch_size: int, tiny: bool, dtype=jnp.bfloat16, mesh=None):
         model = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=100,
                        num_filters=8, dtype=dtype)
     else:
-        model = resnet50(dtype=dtype)
+        model = resnet50(dtype=dtype, fused_bottleneck=fused)
 
     if mesh is None:
         mesh = single_device_mesh()
@@ -111,10 +112,11 @@ def build(batch_size: int, tiny: bool, dtype=jnp.bfloat16, mesh=None):
 
 
 def run(batch_size: int, tiny: bool, dtype=jnp.bfloat16, warmup: int = 8,
-        iters: int = 30, measure_duty: bool = True, mesh=None):
+        iters: int = 30, measure_duty: bool = True, mesh=None,
+        fused: bool = False):
     from pytorch_distributed_tpu.utils.profiling import device_duty_cycle
 
-    state, step, batch = build(batch_size, tiny, dtype, mesh=mesh)
+    state, step, batch = build(batch_size, tiny, dtype, mesh=mesh, fused=fused)
     for _ in range(warmup):
         state, metrics = step(state, batch)
     # Sync by fetching a value: through tunneled TPU runtimes,
@@ -225,9 +227,10 @@ def main() -> None:
     batch_size = int(os.environ.get("BENCH_BS", "64" if tiny else "128"))
     if batch_size < 1:
         raise ValueError(f"BENCH_BS must be >= 1, got {batch_size}")
+    fused = os.environ.get("BENCH_FUSED", "1") == "1" and not tiny
     while True:
         try:
-            img_s, step_s, duty = run(batch_size, tiny)
+            img_s, step_s, duty = run(batch_size, tiny, fused=fused)
             break
         except Exception as e:  # XlaRuntimeError isn't a stable import path
             if "RESOURCE_EXHAUSTED" in str(e) and batch_size > 8:
@@ -243,6 +246,7 @@ def main() -> None:
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
         "batch_size": batch_size,
         "step_ms": round(step_s * 1e3, 2),
+        "fused_bottleneck": fused,
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
     }

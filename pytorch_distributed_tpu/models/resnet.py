@@ -158,6 +158,304 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class _Kernel1x1(nn.Module):
+    """Scope holder for a 1x1 conv kernel: creates ``<name>/kernel`` with
+    the exact shape/name ``nn.Conv`` would, but returns the raw parameter so
+    the caller can both apply the conv and use the weights in stats math
+    (see ``FusedBottleneckBlock``)."""
+
+    features: int
+    kernel_init: Any = conv_kernel_init
+
+    @nn.compact
+    def __call__(self, in_features: int) -> jax.Array:
+        return self.param(
+            "kernel", self.kernel_init, (1, 1, in_features, self.features),
+            jnp.float32,
+        )
+
+
+class _TailBatchNorm(nn.Module):
+    """Owns BN3's params/running stats around ``_fused_expand_tail``.
+
+    The tail consumes (gamma, beta) and *produces* the batch stats, so this
+    module hands its parameters to a caller-supplied closure and applies the
+    running-average update to whatever stats come back. Same variable layout
+    as ``nn.BatchNorm`` — checkpoints interchange with the plain block."""
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, features: int, run_tail, train: bool):
+        gamma = self.param("scale", nn.initializers.ones, (features,), jnp.float32)
+        beta = self.param("bias", nn.initializers.zeros, (features,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+        if train:
+            out, mean, var = run_tail(gamma, beta, None, None)
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value + (1 - self.momentum) * var
+                )
+        else:
+            out, _, _ = run_tail(gamma, beta, ra_mean.value, ra_var.value)
+        return out
+
+
+class _MomentBatchNorm(nn.Module):
+    """BatchNorm whose batch statistics are supplied by the caller.
+
+    Parameter/variable layout is identical to ``nn.BatchNorm`` (params
+    scale/bias, batch_stats mean/var), so checkpoints interchange with the
+    plain block. The caller computes the batch stats from input moments
+    (exactly — see FusedBottleneckBlock) instead of from a materialized
+    pre-normalization tensor; this module just owns the state and applies
+    the affine + running-average bookkeeping."""
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, features: int, batch_mean, batch_var, train: bool):
+        """Returns fp32 ``(scale, bias)`` such that
+        ``bn(y) = y * scale + bias`` for raw conv output ``y``."""
+        gamma = self.param("scale", nn.initializers.ones, (features,), jnp.float32)
+        beta = self.param("bias", nn.initializers.zeros, (features,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+        if train:
+            mean, var = batch_mean, batch_var
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value + (1 - self.momentum) * var
+                )
+        else:
+            mean, var = ra_mean.value, ra_var.value
+        scale = gamma * jax.lax.rsqrt(var + self.epsilon)
+        return scale, beta - mean * scale
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _fused_expand_tail(z2, residual, w, gamma, beta, epsilon):
+    """``relu(bn(conv1x1(z2, w)) + residual)`` with batch stats from input
+    moments, and a hand-written backward.
+
+    Forward: see ``_expand_bn_stats`` — the [*, 4F] pre-BN tensor is never
+    read for statistics, so XLA fuses normalize+add+relu into the conv's
+    epilogue. Backward: the skinny matmul ``P = z2ᵀ(g·mask)`` is
+    simultaneously the conv weight gradient (``P·a``) and the source of
+    BN's reduction ``Σ g·y = colsum(P ∘ w)``, and the moment path's input
+    gradient collapses to F×F-sized corrections — autodiff instead
+    materializes the wide intermediates twice (measured +16 ms/step on
+    the v5e ResNet-50 train step vs this formulation).
+
+    Returns ``(out, batch_mean, batch_var)``.
+    """
+    return _fused_expand_tail_fwd(z2, residual, w, gamma, beta, epsilon)[0]
+
+
+_NHWC_1x1 = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv1x1(x, w2d, strides=(1, 1)):
+    """1x1 NHWC conv with a [Cin, Cout] kernel, in x's dtype."""
+    return jax.lax.conv_general_dilated(
+        x, w2d[None, None].astype(x.dtype), strides, "VALID",
+        dimension_numbers=_NHWC_1x1,
+    )
+
+
+def _moments_nhwc(x):
+    """(Σx, xᵀx) over (B,H,W) of an NHWC tensor, fp32 accumulation.
+
+    Rank-4 contractions on purpose: collapsing B,H,W with a reshape
+    changes the tensor's second-to-last dim and forces a physical
+    retiling copy on TPU (measured: flattening these [*,F] operands cost
+    +8 ms/step on the v5e ResNet-50 step)."""
+    s = jnp.sum(x, axis=(0, 1, 2), dtype=jnp.float32)
+    m2 = jax.lax.dot_general(
+        x, x, (((0, 1, 2), (0, 1, 2)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return s, m2
+
+
+def _fused_expand_tail_fwd(z2, residual, w, gamma, beta, epsilon):
+    # Two measured dead ends are worth recording here: (1) a Pallas
+    # one-pass version of these reductions (ops/bottleneck_tail.py) was
+    # SLOWER in the full step — the custom-call boundary costs XLA its
+    # conv layouts and epilogue fusions, +8.5 ms of layout copies; (2) a
+    # ones-channel augmentation folding Σz2/Σgp into the contractions
+    # broke lane alignment (65 channels pads to 128 lanes, doubling the
+    # bytes of every pass at stage 1/2) for +7 ms. See PERF_NOTES.md.
+    n = z2.shape[0] * z2.shape[1] * z2.shape[2]
+    dt = z2.dtype
+    s, m2 = _moments_nhwc(z2)
+    m = s / n
+    mean = m @ w
+    ey2 = jnp.sum((m2 / n) @ w * w, axis=0)
+    var = ey2 - mean * mean
+    sigma_inv = jax.lax.rsqrt(var + epsilon)
+    a = gamma * sigma_inv
+    b = beta - mean * a
+
+    y3 = _conv1x1(z2, w)
+    out = jax.nn.relu(y3 * a.astype(dt) + b.astype(dt) + residual.astype(dt))
+    saved = (z2, w, gamma, m, m2, mean, var, sigma_inv, a, out)
+    return (out, mean, var), saved
+
+
+def _fused_expand_tail_bwd(epsilon, saved, cotangents):
+    g, g_mean, g_var = cotangents
+    z2, w, gamma, m, m2, mean, var, sigma_inv, a, out = saved
+    n = z2.shape[0] * z2.shape[1] * z2.shape[2]
+
+    gp = jnp.where(out > 0, g, 0)  # [B,h,w,E]; also IS the residual grad
+    # One skinny contraction carries the conv weight grad AND the BN
+    # reductions: p = Σ_(b,h,w) z2 ⊗ gp.
+    p = jax.lax.dot_general(
+        z2, gp, (((0, 1, 2), (0, 1, 2)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [F, E]
+    sb = jnp.sum(gp, axis=(0, 1, 2), dtype=jnp.float32)  # [E] = dL/db
+    sa = jnp.sum(p * w, axis=0)  # [E] = Σ g·y
+    a_grad = sa - mean * sb  # dL/da
+    dgamma = a_grad * sigma_inv
+    dbeta = sb
+    dvar = -0.5 * a_grad * gamma * sigma_inv**3 + g_var
+    dmean = -a * sb - 2.0 * mean * dvar + g_mean
+    dm = w @ dmean  # [F]
+    # dM is symmetric: w·diag(dvar)·wᵀ/n
+    dm2 = (w * dvar) @ w.T / n  # [F, F]
+    dw = p * a + jnp.outer(m, dmean) + 2.0 * (m2 / n) @ w * dvar
+
+    dt = z2.dtype
+    # Both wide matmuls stay 1x1 NHWC convs (layout, see _moments_nhwc);
+    # the elementwise scale/add fuse into their operands.
+    dz = (
+        _conv1x1(gp * a.astype(dt), w.T)
+        + _conv1x1(z2, 2.0 * dm2)
+        + (dm / n).astype(dt)
+    )
+    return dz.astype(dt), gp, dw, dgamma, dbeta
+
+
+_fused_expand_tail.defvjp(_fused_expand_tail_fwd, _fused_expand_tail_bwd)
+
+
+def _expand_bn_stats(z2f, w):
+    """Exact batch stats of ``conv1x1(z, w)`` from the moments of ``z``.
+
+    The 1x1 expand conv is linear, so with ``m = E[z]`` and
+    ``M2 = E[z zᵀ]`` (an F×F matrix, F the *narrow* width):
+
+        E[y_c]  = m · w_c
+        E[y_c²] = w_cᵀ M2 w_c
+
+    This replaces the usual stats pass over the [N, 4F] conv output — the
+    widest tensor in the block — with one skinny [N,F]×[N,F] matmul, which
+    is what lets normalize+add+relu ride as an epilogue of the conv instead
+    of forcing the raw output through HBM twice (PERF_NOTES.md §5 fix #1).
+    Accumulation in fp32 on the MXU, same as a conv's own accumulator.
+    Variance via E[y²]−E[y]², flax's fast-variance formula. ``z`` is NHWC
+    (rank-4 contraction — see ``_moments_nhwc`` for why not flattened).
+    """
+    n = z2f.shape[0] * z2f.shape[1] * z2f.shape[2]
+    s, m2 = _moments_nhwc(z2f)
+    mean = (s / n) @ w
+    ey2 = jnp.sum((m2 / n) @ w * w, axis=0)
+    return mean, ey2 - mean * mean
+
+
+class FusedBottleneckBlock(nn.Module):
+    """BottleneckBlock restructured so the expand tail fuses.
+
+    Identical math and parameter tree to ``BottleneckBlock`` (same conv /
+    BN names, interchangeable checkpoints, same batch-stat semantics); the
+    difference is purely how BN3/downsample-BN batch statistics are
+    obtained: from input moments via ``_expand_bn_stats`` rather than from
+    the materialized raw conv outputs. The [B,H,W,4F] pre-BN tensors — the
+    widest in the network — then never need a separate stats read, and XLA
+    fuses ``relu(y3*scale + bias + residual)`` into the conv epilogue.
+    Profiled on v5e: this was the HBM traffic PERF_NOTES.md §4 showed
+    bounding the step.
+    """
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+    expansion: int = 4
+    dtype: Any = jnp.float32
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        f, e = self.filters, self.expansion
+        y = self.conv(f, (1, 1), (1, 1), name="Conv_0")(x)
+        y = self.norm(name="BatchNorm_0")(y)
+        y = nn.relu(y)
+        y = self.conv(
+            f, (3, 3), (self.strides, self.strides),
+            padding=[(1, 1), (1, 1)], name="Conv_1",
+        )(y)
+        y = self.norm(name="BatchNorm_1")(y)
+        z2 = nn.relu(y)  # [B, h, w, F] compute dtype
+
+        w3 = _Kernel1x1(f * e, name="Conv_2")(f)[0, 0]  # [F, 4F] fp32
+
+        if x.shape[-1] != f * e or self.strides != 1:
+            wd = _Kernel1x1(f * e, name="downsample_conv")(x.shape[-1])[0, 0]
+            if train:
+                xs = x[:, :: self.strides, :: self.strides, :]
+                ds_mean, ds_var = _expand_bn_stats(xs, wd)
+            else:
+                ds_mean = ds_var = None
+            scaled, biasd = _MomentBatchNorm(
+                self.momentum, self.epsilon, name="downsample_bn"
+            )(f * e, ds_mean, ds_var, train)
+            ds = _conv1x1(
+                x.astype(self.dtype), wd, (self.strides, self.strides)
+            )
+            residual = ds * scaled.astype(self.dtype) + biasd.astype(self.dtype)
+        else:
+            residual = x.astype(self.dtype)
+
+        def run_tail(gamma, beta, ra_mean, ra_var):
+            if ra_mean is None:  # train: stats from moments inside the vjp
+                return _fused_expand_tail(
+                    z2, residual, w3, gamma, beta, self.epsilon
+                )
+            scale = gamma * jax.lax.rsqrt(ra_var + self.epsilon)
+            bias = beta - ra_mean * scale
+            y3 = _conv1x1(z2.astype(self.dtype), w3)
+            out = nn.relu(
+                y3 * scale.astype(self.dtype) + bias.astype(self.dtype)
+                + residual
+            )
+            return out, ra_mean, ra_var
+
+        return _TailBatchNorm(self.momentum, self.epsilon, name="BatchNorm_2")(
+            f * e, run_tail, train
+        )
+
+
 class SpaceToDepthStem(nn.Module):
     """The 7×7/2 ImageNet stem computed on a space-to-depth input.
 
@@ -225,6 +523,10 @@ class ResNet(nn.Module):
       space_to_depth_stem: compute the stem on a [H/2, W/2, 12] input (see
         ``SpaceToDepthStem``) — mathematically identical, checkpoint-
         compatible, avoids the C_in=3 lane waste of the 7x7 conv.
+      fused_bottleneck: use ``FusedBottleneckBlock`` (bottleneck stages
+        only): same math, same checkpoint tree, but the expand-tail BN
+        stats come from input moments so the widest activations skip a
+        stats pass and normalize+add+relu fuse into the conv epilogue.
     """
 
     stage_sizes: Sequence[int]
@@ -236,6 +538,7 @@ class ResNet(nn.Module):
     use_dot_1x1: bool = False
     remat_blocks: bool = False
     space_to_depth_stem: bool = False
+    fused_bottleneck: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -275,20 +578,39 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
 
-        block_cls = self.block_cls
+        fused = self.fused_bottleneck and self.block_cls is BottleneckBlock
+        if fused and self.bn_cross_replica_axis is not None:
+            raise NotImplementedError(
+                "fused_bottleneck computes BN3/downsample batch stats from "
+                "local input moments and does not psum them across "
+                f"'{self.bn_cross_replica_axis}'; sync-BN needs the plain "
+                "blocks (fused_bottleneck=False). (The moments are "
+                "additive, so a psum'd variant is possible — unbuilt.)"
+            )
+        block_cls = FusedBottleneckBlock if fused else self.block_cls
         if self.remat_blocks:
-            block_cls = nn.remat(block_cls)
+            block_cls = nn.remat(block_cls, static_argnums=(2,) if fused else ())
         for i, stage_size in enumerate(self.stage_sizes):
             for j in range(stage_size):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = block_cls(
-                    filters=self.num_filters * 2**i,
-                    conv=conv,
-                    norm=norm,
-                    strides=strides,
-                    pointwise=pointwise,
-                    name=f"stage{i + 1}_block{j + 1}",
-                )(x)
+                if fused:
+                    x = block_cls(
+                        filters=self.num_filters * 2**i,
+                        conv=conv,
+                        norm=norm,
+                        strides=strides,
+                        dtype=self.dtype,
+                        name=f"stage{i + 1}_block{j + 1}",
+                    )(x, train)
+                else:
+                    x = block_cls(
+                        filters=self.num_filters * 2**i,
+                        conv=conv,
+                        norm=norm,
+                        strides=strides,
+                        pointwise=pointwise,
+                        name=f"stage{i + 1}_block{j + 1}",
+                    )(x)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)

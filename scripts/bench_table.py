@@ -65,10 +65,12 @@ def run_row(row: str) -> dict:
     mesh = make_mesh() if "8dev" in row else single_device_mesh()
     n_dev = int(mesh.devices.size)
     bs = per_dev_bs * n_dev
-    # Same build/timing/round-trip-correction path as the headline bench.
+    # Same build/timing/round-trip-correction path as the headline bench,
+    # including its fused-bottleneck default (BENCH_FUSED).
+    fused = os.environ.get("BENCH_FUSED", "1") == "1" and not tiny
     img_s, step_s, _ = bench.run(
         bs, tiny, dtype=dtype, mesh=mesh, measure_duty=False,
-        warmup=5, iters=10 if tiny else 30,
+        warmup=5, iters=10 if tiny else 30, fused=fused,
     )
     return {"row": row, "n_dev": n_dev, "batch_size": bs,
             "img_s": round(img_s, 2), "step_ms": round(step_s * 1e3, 2),

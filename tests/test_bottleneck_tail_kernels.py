@@ -1,0 +1,62 @@
+"""Correctness of the Pallas one-pass reduction kernels
+(ops/bottleneck_tail.py). These are a *documented negative perf result*
+(PERF_NOTES.md §6: the custom-call boundary costs XLA more in layout
+copies/fusions than the one-pass reads save), kept correct so the
+measurement is reproducible and the kernels are available if the
+boundary economics change (e.g. a whole-block Pallas path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.ops import bottleneck_tail as bt
+
+
+def _data(dtype=jnp.float32, b=3, h=6, w=6, f=8, e=16, seed=0):
+    r = np.random.default_rng(seed)
+    z = jnp.asarray(r.standard_normal((b, h, w, f)), dtype)
+    g = jnp.asarray(r.standard_normal((b, h, w, e)), dtype)
+    out = jnp.asarray(r.standard_normal((b, h, w, e)), dtype)
+    return z, g, out
+
+
+def test_moments_matches_xla():
+    z, _, _ = _data()
+    s, m2 = bt.moments(z)
+    np.testing.assert_allclose(
+        np.asarray(s), np.asarray(jnp.sum(z, axis=(0, 1, 2))), rtol=1e-5
+    )
+    ref = jax.lax.dot_general(z, z, (((0, 1, 2), (0, 1, 2)), ((), ())))
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(ref), rtol=1e-5)
+
+
+def test_bwd_reduce_matches_xla():
+    z, g, out = _data(seed=1)
+    gp, p, sb = bt.tail_bwd_reduce(z, g, out)
+    gp_ref = jnp.where(out > 0, g, 0)
+    np.testing.assert_array_equal(np.asarray(gp), np.asarray(gp_ref))
+    p_ref = jax.lax.dot_general(
+        z, gp_ref, (((0, 1, 2), (0, 1, 2)), ((), ()))
+    )
+    np.testing.assert_allclose(np.asarray(p), np.asarray(p_ref), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sb), np.asarray(jnp.sum(gp_ref, axis=(0, 1, 2))),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_bwd_dz_matches_xla():
+    z, g, out = _data(seed=2)
+    f, e = z.shape[-1], g.shape[-1]
+    r = np.random.default_rng(3)
+    gp = jnp.where(out > 0, g, 0)
+    wa = jnp.asarray(r.standard_normal((e, f)), jnp.float32)
+    c = jnp.asarray(r.standard_normal((f, f)), jnp.float32)
+    dmn = jnp.asarray(r.standard_normal((1, f)), jnp.float32)
+    dz = bt.tail_bwd_dz(gp, z, wa, c, dmn)
+    ref = (
+        gp.reshape(-1, e) @ wa + z.reshape(-1, f) @ c + dmn
+    ).reshape(z.shape)
+    np.testing.assert_allclose(np.asarray(dz), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
