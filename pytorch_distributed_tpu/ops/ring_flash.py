@@ -64,16 +64,22 @@ def _shard_fwd(q3, k3, v3, scale, causal_block, block_q, block_k, interpret):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
-def _ring_flash(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+def _ring_flash(q, k, v, axis, causal, scale, block_q, block_k, interpret,
+                layout):
     out, _ = _ring_flash_fwd(
-        q, k, v, axis, causal, scale, block_q, block_k, interpret
+        q, k, v, axis, causal, scale, block_q, block_k, interpret, layout
     )
     return out
 
 
-def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret,
+                    layout):
+    if layout == "zigzag":
+        return _ring_flash_zigzag_fwd(
+            q, k, v, axis, scale, block_q, block_k, interpret
+        )
     s = jax.lax.psum(1, axis)
     my = jax.lax.axis_index(axis)
     b, lq, h, d = q.shape
@@ -141,7 +147,12 @@ def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret):
     return _from3(o3, b, h), (q, k, v, o3, lse)
 
 
-def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret, res, g):
+def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret, layout,
+                    res, g):
+    if layout == "zigzag":
+        return _ring_flash_zigzag_bwd(
+            axis, scale, block_q, block_k, interpret, res, g
+        )
     q, k, v, o3, lse = res
     b, lq, h, d = q.shape
     s = jax.lax.psum(1, axis)
@@ -216,6 +227,211 @@ def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret, res, g):
     )
 
 
+def _ring_flash_zigzag_fwd(q, k, v, axis, scale, block_q, block_k, interpret):
+    """Causal forward on the zigzag layout: rank r holds chunks
+    (r, 2s-1-r); of the four (q-chunk, kv-chunk) pairs per visiting shard
+    one is always visible, one never (omitted), and the two chunk-diagonal
+    pairs carry runtime conds — every rank runs ~2 chunk kernels per step
+    (the balance argument: parallel/sequence.py `_ring_attention_zigzag`)."""
+    s = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    b, lq, h, d = q.shape
+    c = lq // 2
+    q3, k3, v3 = _to3(q), _to3(k), _to3(v)
+    bh = q3.shape[0]
+    q_lo, q_hi = q3[:, :c], q3[:, c:]
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def merge(state, o3, lse):
+        m, l, acc = state
+        m_new = jnp.maximum(m, lse)
+        corr = jnp.exp(m - m_new)
+        w = jnp.exp(lse - m_new)
+        return (m_new, l * corr + w,
+                acc * corr + o3.astype(jnp.float32) * w)
+
+    def pair(state, qc, kc, vc, causal_block):
+        return merge(state, *_shard_fwd(qc, kc, vc, scale, causal_block,
+                                        block_q, block_k, interpret))
+
+    def fold(states, k_cur, v_cur, step):
+        st_lo, st_hi = states
+        src = jax.lax.rem(my - step + s, s)
+        k_lo, k_hi = k_cur[:, :c], k_cur[:, c:]
+        v_lo, v_hi = v_cur[:, :c], v_cur[:, c:]
+        # (q_lo, kv_lo): diag at src==my, full at src<my, masked after
+        st_lo = jax.lax.cond(
+            src > my, lambda st: st,
+            lambda st: jax.lax.cond(
+                src == my,
+                lambda st2: pair(st2, q_lo, k_lo, v_lo, True),
+                lambda st2: pair(st2, q_lo, k_lo, v_lo, False),
+                st,
+            ),
+            st_lo,
+        )
+        # (q_hi, kv_lo): always fully visible
+        st_hi = pair(st_hi, q_hi, k_lo, v_lo, False)
+        # (q_hi, kv_hi): diag at src==my, full at src>my, masked before
+        st_hi = jax.lax.cond(
+            src < my, lambda st: st,
+            lambda st: jax.lax.cond(
+                src == my,
+                lambda st2: pair(st2, q_hi, k_hi, v_hi, True),
+                lambda st2: pair(st2, q_hi, k_hi, v_hi, False),
+                st,
+            ),
+            st_hi,
+        )
+        return (st_lo, st_hi)
+
+    def body(carry, step):
+        states, (k_cur, v_cur) = carry
+        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis, perm)
+        states = fold(states, k_cur, v_cur, step)
+        return (states, (k_nxt, v_nxt)), None
+
+    def zero_state():
+        return (
+            jnp.full((bh, c, 1), NEG_INF, jnp.float32),
+            jnp.zeros((bh, c, 1), jnp.float32),
+            jnp.zeros((bh, c, d), jnp.float32),
+        )
+
+    init = ((zero_state(), zero_state()), (k3, v3))
+    if s > 1:
+        (states, (k_last, v_last)), _ = jax.lax.scan(
+            body, init, jnp.arange(s - 1)
+        )
+    else:
+        states, (k_last, v_last) = init
+    st_lo, st_hi = fold(states, k_last, v_last, s - 1)
+
+    def finalize(state):
+        m, l, acc = state
+        l_safe = jnp.maximum(l, 1e-37)
+        o3 = (acc / l_safe).astype(q.dtype)
+        lse = jnp.where(l > 0.0, m + jnp.log(l_safe), NEG_INF)
+        return o3, lse
+
+    o_lo, lse_lo = finalize(st_lo)
+    o_hi, lse_hi = finalize(st_hi)
+    o3 = jnp.concatenate([o_lo, o_hi], axis=1)
+    lse = jnp.concatenate([lse_lo, lse_hi], axis=1)
+    return _from3(o3, b, h), (q, k, v, o3, lse)
+
+
+def _ring_flash_zigzag_bwd(axis, scale, block_q, block_k, interpret, res, g):
+    """Zigzag backward: per-pair FlashAttention-2 kernels with the global
+    LSE; dq accumulates per local q chunk, dk/dv accumulators travel with
+    their shard (same traveling scheme as the contiguous backward) with
+    per-chunk slice updates."""
+    q, k, v, o3, lse = res
+    b, lq, h, d = q.shape
+    c = lq // 2
+    s = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    q3, k3, v3, do3 = _to3(q), _to3(k), _to3(v), _to3(g.astype(q.dtype))
+    bh = q3.shape[0]
+    lse3 = jnp.broadcast_to(lse, (bh, lq, 128))
+    delta3 = compute_delta(do3, o3)
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    chunks = {
+        "lo": (q3[:, :c], o3[:, :c], lse3[:, :c], do3[:, :c], delta3[:, :c]),
+        "hi": (q3[:, c:], o3[:, c:], lse3[:, c:], do3[:, c:], delta3[:, c:]),
+    }
+
+    def pair_bwd(which, kc, vc, causal_block):
+        qc, oc, lsec, doc, dc = chunks[which]
+        return _flash_bwd(
+            qc, kc, vc, oc, lsec, doc, scale, causal_block,
+            block_q, block_k, kc.shape[1], interpret, delta3=dc,
+        )
+
+    def fold(dq_acc, dkv_cur, k_cur, v_cur, step):
+        src = jax.lax.rem(my - step + s, s)
+        k_lo, k_hi = k_cur[:, :c], k_cur[:, c:]
+        v_lo, v_hi = v_cur[:, :c], v_cur[:, c:]
+        dq_lo, dq_hi = dq_acc
+        dk_cur, dv_cur = dkv_cur
+
+        def add_lo(dk, dkc):
+            return dk.at[:, :c].add(dkc.astype(jnp.float32))
+
+        def add_hi(dk, dkc):
+            return dk.at[:, c:].add(dkc.astype(jnp.float32))
+
+        # (q_lo, kv_lo)
+        def run_ll(args, causal_block):
+            dq_lo, dk_cur, dv_cur = args
+            dq3, dk3, dv3 = pair_bwd("lo", k_lo, v_lo, causal_block)
+            return (dq_lo + dq3.astype(jnp.float32), add_lo(dk_cur, dk3),
+                    add_lo(dv_cur, dv3))
+
+        dq_lo, dk_cur, dv_cur = jax.lax.cond(
+            src > my, lambda a: a,
+            lambda a: jax.lax.cond(
+                src == my, functools.partial(run_ll, causal_block=True),
+                functools.partial(run_ll, causal_block=False), a,
+            ),
+            (dq_lo, dk_cur, dv_cur),
+        )
+        # (q_hi, kv_lo): always runs
+        dq3, dk3, dv3 = pair_bwd("hi", k_lo, v_lo, False)
+        dq_hi = dq_hi + dq3.astype(jnp.float32)
+        dk_cur, dv_cur = add_lo(dk_cur, dk3), add_lo(dv_cur, dv3)
+
+        # (q_hi, kv_hi)
+        def run_hh(args, causal_block):
+            dq_hi, dk_cur, dv_cur = args
+            dq3, dk3, dv3 = pair_bwd("hi", k_hi, v_hi, causal_block)
+            return (dq_hi + dq3.astype(jnp.float32), add_hi(dk_cur, dk3),
+                    add_hi(dv_cur, dv3))
+
+        dq_hi, dk_cur, dv_cur = jax.lax.cond(
+            src < my, lambda a: a,
+            lambda a: jax.lax.cond(
+                src == my, functools.partial(run_hh, causal_block=True),
+                functools.partial(run_hh, causal_block=False), a,
+            ),
+            (dq_hi, dk_cur, dv_cur),
+        )
+        return (dq_lo, dq_hi), (dk_cur, dv_cur)
+
+    def body(carry, step):
+        dq_acc, (k_cur, v_cur, dk_cur, dv_cur) = carry
+        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis, perm)
+        dq_acc, (dk_new, dv_new) = fold(dq_acc, (dk_cur, dv_cur), k_cur,
+                                        v_cur, step)
+        dk_nxt, dv_nxt = jax.lax.ppermute((dk_new, dv_new), axis, perm)
+        return (dq_acc, (k_nxt, v_nxt, dk_nxt, dv_nxt)), None
+
+    zeros_kv = jnp.zeros((bh, lq, d), jnp.float32)
+    init = (
+        (jnp.zeros((bh, c, d), jnp.float32),
+         jnp.zeros((bh, c, d), jnp.float32)),
+        (k3, v3, zeros_kv, zeros_kv),
+    )
+    if s > 1:
+        (dq_acc, (k_last, v_last, dk_last, dv_last)), _ = jax.lax.scan(
+            body, init, jnp.arange(s - 1)
+        )
+    else:
+        dq_acc, (k_last, v_last, dk_last, dv_last) = init
+    dq_acc, (dk_new, dv_new) = fold(dq_acc, (dk_last, dv_last), k_last,
+                                    v_last, s - 1)
+    # one more rotation lands each accumulator on its shard's home rank
+    dk_home, dv_home = jax.lax.ppermute((dk_new, dv_new), axis, perm)
+
+    dq3 = jnp.concatenate(dq_acc, axis=1)
+    return (
+        _from3(dq3.astype(q.dtype), b, h),
+        _from3(dk_home.astype(k.dtype), b, h),
+        _from3(dv_home.astype(v.dtype), b, h),
+    )
+
+
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
@@ -230,15 +446,20 @@ def ring_flash_attention(
     block_q: int = 256,
     block_k: int = 512,
     interpret: bool = False,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Ring attention with Pallas flash kernels per visiting shard (call
     under shard_map; same contract as ``parallel.sequence.ring_attention``:
-    ``[B, L_local, H, D]`` shards of a contiguously-sharded sequence).
+    ``[B, L_local, H, D]`` shards of a contiguously-sharded sequence, or —
+    with ``layout="zigzag"`` — shards holding chunks (r, 2s-1-r) of the
+    2s-chunk decomposition (``parallel.sequence.zigzag_shard``), which
+    balances the causal critical path across ranks.
 
-    Requires equal-length shards with L_local a multiple of the clamped
-    block sizes; use ``ring_attention`` for anything irregular. Note
-    ``base_offset`` is unsupported (the causal structure is derived from
-    ring positions, which already encode absolute order).
+    Requires equal-length shards with L_local (each half-chunk, for
+    zigzag) a multiple of the clamped block sizes; use ``ring_attention``
+    for anything irregular. Note ``base_offset`` is unsupported (the
+    causal structure is derived from ring positions, which already encode
+    absolute order).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk = q.shape[1], k.shape[1]
@@ -246,6 +467,26 @@ def ring_flash_attention(
         raise ValueError(
             f"ring flash needs equal Q/KV shard lengths, got {lq} vs {lk}"
         )
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError(
+                "zigzag layout only changes causal scheduling; use "
+                "layout='contiguous' for non-causal attention"
+            )
+        if lq % 2:
+            raise ValueError(f"zigzag needs an even shard length, got {lq}")
+        c = lq // 2
+        block_q = min(block_q, c)
+        block_k = min(block_k, c)
+        if c % block_q or c % block_k:
+            raise ValueError(
+                f"zigzag chunk length {c} must be a multiple of the block "
+                f"sizes ({block_q}, {block_k})"
+            )
+        return _ring_flash(q, k, v, axis, True, scale, block_q, block_k,
+                           interpret, "zigzag")
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     if lq % block_q or lk % block_k:
@@ -254,4 +495,4 @@ def ring_flash_attention(
             f"({block_q}, {block_k}); pad the sequence or use ring_attention"
         )
     return _ring_flash(q, k, v, axis, causal, scale, block_q, block_k,
-                       interpret)
+                       interpret, "contiguous")

@@ -24,9 +24,17 @@ Causal runs skip fully-masked visiting shards entirely (a KV shard whose
 every key is in the future of every local query contributes nothing — a
 ``lax.cond`` keeps the scan structure static while the branch's matmuls
 never execute), recovering ~2x of the plain ring schedule's waste at no
-change in results. The remaining imbalance (later ring positions fold more
-real blocks than earlier ones) is what a zigzag/striped layout would fix;
-noted so the cost model is honest.
+change in results. That fixes FLOPs but not wall-clock: with contiguous
+shards rank s-1 still folds s real shards while rank 0 folds one, so the
+critical path is unimproved. ``layout="zigzag"`` fixes the balance: the
+global sequence is cut into 2s chunks and rank r holds chunks
+(r, 2s-1-r) — one early, one late. Of the four (q-chunk × kv-chunk)
+pairs per visiting shard, one is ALWAYS fully visible, one NEVER
+(statically omitted), and only the two chunk-diagonal pairs carry a
+runtime cond — every rank folds exactly ~2 real chunk-blocks per step,
+halving the causal critical path at sp >= 4 (counter-measured in
+tests/test_sequence.py; use ``parallel.sequence.zigzag_shard`` to lay
+global arrays out so contiguous sharding delivers each rank its chunks).
 """
 
 from __future__ import annotations
@@ -42,6 +50,37 @@ from pytorch_distributed_tpu.ops.attention import SoftmaxState, attend_block
 from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, shard_map
 
 
+def zigzag_shard(x, s: int, axis: int = 1):
+    """Reorder a global array so CONTIGUOUS equal sharding over ``s``
+    devices delivers the zigzag layout: shard r = chunks (r, 2s-1-r) of
+    the 2s-chunk decomposition along ``axis``. Inverse: `zigzag_unshard`.
+    Apply to every per-token array (tokens, labels, weights) so they stay
+    aligned — tested with ``train.lm.shift_labels`` in test_sequence.py."""
+    import numpy as np
+
+    l = x.shape[axis]
+    if l % (2 * s):
+        raise ValueError(f"length {l} not divisible by 2*{s} chunks")
+    order = np.concatenate([[r, 2 * s - 1 - r] for r in range(s)])
+    parts = jnp.split(x, 2 * s, axis=axis) if hasattr(x, "dtype") else None
+    if parts is None:
+        parts = np.split(x, 2 * s, axis=axis)
+        return np.concatenate([parts[i] for i in order], axis=axis)
+    return jnp.concatenate([parts[i] for i in order], axis=axis)
+
+
+def zigzag_unshard(x, s: int, axis: int = 1):
+    """Inverse of :func:`zigzag_shard`."""
+    import numpy as np
+
+    order = np.concatenate([[r, 2 * s - 1 - r] for r in range(s)])
+    inv = np.argsort(order)
+    cat = jnp.concatenate if hasattr(x, "dtype") else np.concatenate
+    split = jnp.split if hasattr(x, "dtype") else np.split
+    parts = split(x, 2 * s, axis=axis)
+    return cat([parts[i] for i in inv], axis=axis)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -52,22 +91,46 @@ def ring_attention(
     scale: Optional[float] = None,
     base_offset: jax.Array | int = 0,
     remat: bool = True,
+    layout: str = "contiguous",
+    with_schedule_counts: bool = False,
 ) -> jax.Array:
     """Attention over a sequence sharded on ``axis`` (call under shard_map).
 
     Args:
       q, k, v: this device's shards, ``[B, L_local, H, D]``; global length
         is ``L_local * axis_size``, shard i holding tokens
-        ``[base_offset + i*L_local, base_offset + (i+1)*L_local)``.
+        ``[base_offset + i*L_local, base_offset + (i+1)*L_local)``
+        (contiguous layout) or chunks ``(i, 2s-1-i)`` of the 2s-chunk
+        decomposition (zigzag — see :func:`zigzag_shard`).
       causal: apply the global causal mask (offsets handled per ring step).
       base_offset: absolute position of the sharded sequence's first token
         (non-zero when attending over a chunk of a longer document).
+      layout: "contiguous" or "zigzag" (causal only; balances the causal
+        critical path across ranks — module docstring).
+      with_schedule_counts: also return this rank's executed block area
+        (q_len*k_len summed over attend calls that actually RAN — the
+        counter lives inside the cond branches, so skipped shards don't
+        count). Shape [1] f32; gather over the axis to see the per-rank
+        causal balance. This is the compute that becomes per-rank
+        wall-clock on a real ring — measured in tests/test_sequence.py.
 
     Returns: ``[B, L_local, H, D]`` — this device's rows of the exact
     softmax(QK^T)V over the full sequence (bit-comparable to dense
     attention on the gathered sequence, up to fp accumulation order).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError(
+                "zigzag layout only changes causal scheduling; use "
+                "layout='contiguous' for non-causal attention"
+            )
+        return _ring_attention_zigzag(
+            q, k, v, axis=axis, scale=scale, base_offset=base_offset,
+            remat=remat, with_schedule_counts=with_schedule_counts,
+        )
+    if layout != "contiguous":
+        raise ValueError(f"unknown layout {layout!r}")
     s = jax.lax.psum(1, axis)
     my = jax.lax.axis_index(axis)
     b, lq, h, d = q.shape
@@ -75,26 +138,30 @@ def ring_attention(
     q_offset = base_offset + my * lq
     perm = [(i, (i + 1) % s) for i in range(s)]
 
-    def fold(state, k_cur, v_cur, step):
+    def fold(state_counts, k_cur, v_cur, step):
+        state, counts = state_counts
         # kv shard currently held originated on device (my - step) mod s
         src = jax.lax.rem(my - step + s, s)
 
-        def attend(st):
-            return attend_block(
+        def attend(st_c):
+            st, c_ = st_c
+            st = attend_block(
                 st, q, k_cur, v_cur,
                 scale=scale, causal=causal,
                 q_offset=q_offset, k_offset=base_offset + src * lk,
             )
+            return st, c_ + float(lq * lk)
 
         if not causal:
-            return attend(state)
+            return attend((state, counts))
         # Shards are CONTIGUOUS position blocks, so a visiting shard from a
         # later ring position (src > my) is entirely in every local query's
         # future: fully masked, contributes nothing — skip its matmuls.
         # (Equal-length shards ⇒ the block test reduces to src > my.)
         if lk != lq:
-            return attend(state)  # unequal shards: no block-level shortcut
-        return jax.lax.cond(src > my, lambda st: st, attend, state)
+            return attend((state, counts))  # unequal: no block shortcut
+        return jax.lax.cond(src > my, lambda st_c: st_c, attend,
+                            (state, counts))
 
     def body(carry, step):
         state, (k_cur, v_cur) = carry
@@ -108,16 +175,118 @@ def ring_attention(
         body = jax.checkpoint(body)
         fold = jax.checkpoint(fold)
 
-    init = (SoftmaxState.zero(b, lq, h, d), (k, v))
+    init = ((SoftmaxState.zero(b, lq, h, d), jnp.zeros((1,), jnp.float32)),
+            (k, v))
     # s-1 rotate+fold steps, then fold the last visiting shard with no
     # rotation — a full-s scan would ship K/V around the ring once more
     # only to discard them.
     if s > 1:
-        (state, (k_last, v_last)), _ = jax.lax.scan(body, init, jnp.arange(s - 1))
+        (state_counts, (k_last, v_last)), _ = jax.lax.scan(
+            body, init, jnp.arange(s - 1)
+        )
     else:
-        state, (k_last, v_last) = init
-    state = fold(state, k_last, v_last, s - 1)
-    return state.finalize(q.dtype)
+        state_counts, (k_last, v_last) = init
+    state, counts = fold(state_counts, k_last, v_last, s - 1)
+    out = state.finalize(q.dtype)
+    return (out, counts) if with_schedule_counts else out
+
+
+def _ring_attention_zigzag(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    scale: float,
+    base_offset: jax.Array | int = 0,
+    remat: bool = True,
+    with_schedule_counts: bool = False,
+) -> jax.Array:
+    """Causal ring attention on the zigzag layout (module docstring).
+
+    Rank r holds q/kv chunks (r, 2s-1-r), each of length c = L_local/2.
+    Visiting shard from rank ``src`` carries kv chunks (src, 2s-1-src).
+    Chunk-index algebra (all chunks are contiguous position ranges):
+      (q_lo=r,      kv_lo=src):      diag if src==r, full if src<r, skip else
+      (q_lo=r,      kv_hi=2s-1-src): 2s-1-src >= s > r — ALWAYS masked, omitted
+      (q_hi=2s-1-r, kv_lo=src):      src <= s-1 < 2s-1-r — ALWAYS fully visible
+      (q_hi=2s-1-r, kv_hi=2s-1-src): diag if src==r, full if src>r, skip else
+    So every rank folds exactly two real chunk-blocks per step (plus the
+    within-chunk diagonals on the src==r step): balanced critical path.
+    """
+    s = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    b, lq, h, d = q.shape
+    if lq % 2 or k.shape[1] != lq:
+        raise ValueError(
+            f"zigzag needs equal, even-length shards; got q {lq}, k {k.shape[1]}"
+        )
+    c = lq // 2
+    perm = [(i, (i + 1) % s) for i in range(s)]
+    q_lo, q_hi = q[:, :c], q[:, c:]
+    lo_off = base_offset + my * c
+    hi_off = base_offset + (2 * s - 1 - my) * c
+
+    def fold(states, k_cur, v_cur, step):
+        st_lo, st_hi, counts = states
+        src = jax.lax.rem(my - step + s, s)
+        k_lo, k_hi = k_cur[:, :c], k_cur[:, c:]
+        v_lo, v_hi = v_cur[:, :c], v_cur[:, c:]
+        src_lo_off = base_offset + src * c
+        src_hi_off = base_offset + (2 * s - 1 - src) * c
+
+        def pair(st_c, qc, q_off, kc, vc, k_off):
+            st, c_ = st_c
+            st = attend_block(st, qc, kc, vc, scale=scale, causal=True,
+                              q_offset=q_off, k_offset=k_off)
+            return st, c_ + float(c * c)
+
+        # (q_lo, kv_lo): runs unless src > my (attend_block's positional
+        # mask handles both the src==my diagonal and src<my full case)
+        st_lo, counts = jax.lax.cond(
+            src > my,
+            lambda st_c: st_c,
+            lambda st_c: pair(st_c, q_lo, lo_off, k_lo, v_lo, src_lo_off),
+            (st_lo, counts),
+        )
+        # (q_hi, kv_lo): always fully visible
+        st_hi, counts = pair((st_hi, counts), q_hi, hi_off, k_lo, v_lo,
+                             src_lo_off)
+        # (q_hi, kv_hi): runs unless src < my
+        st_hi, counts = jax.lax.cond(
+            src < my,
+            lambda st_c: st_c,
+            lambda st_c: pair(st_c, q_hi, hi_off, k_hi, v_hi, src_hi_off),
+            (st_hi, counts),
+        )
+        return (st_lo, st_hi, counts)
+
+    def body(carry, step):
+        states, (k_cur, v_cur) = carry
+        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis, perm)
+        states = fold(states, k_cur, v_cur, step)
+        return (states, (k_nxt, v_nxt)), None
+
+    if remat:
+        body = jax.checkpoint(body)
+        fold = jax.checkpoint(fold)
+
+    init = (
+        (SoftmaxState.zero(b, c, h, d), SoftmaxState.zero(b, c, h, d),
+         jnp.zeros((1,), jnp.float32)),
+        (k, v),
+    )
+    if s > 1:
+        (states, (k_last, v_last)), _ = jax.lax.scan(
+            body, init, jnp.arange(s - 1)
+        )
+    else:
+        states, (k_last, v_last) = init
+    st_lo, st_hi, counts = fold(states, k_last, v_last, s - 1)
+    out = jnp.concatenate(
+        [st_lo.finalize(q.dtype), st_hi.finalize(q.dtype)], axis=1
+    )
+    return (out, counts) if with_schedule_counts else out
 
 
 def ring_attention_sharded(
@@ -128,13 +297,16 @@ def ring_attention_sharded(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Convenience wrapper: global ``[B, L, H, D]`` arrays, batch sharded on
     ``data`` and length on ``seq``; returns the globally-sharded output.
+    With ``layout="zigzag"``, inputs must already be in zigzag order
+    (:func:`zigzag_shard`), and the output comes back in that order.
     Inside a larger shard_map'd step, call ``ring_attention`` directly."""
     spec = P(DATA_AXIS, SEQ_AXIS)
     fn = shard_map(
-        partial(ring_attention, causal=causal, scale=scale),
+        partial(ring_attention, causal=causal, scale=scale, layout=layout),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -132,3 +132,77 @@ def test_lm_ring_flash_matches_ring(devices8):
         rf.ring_flash_attention = orig
     losses_ring = run("ring")
     np.testing.assert_allclose(losses_rf, losses_ring, rtol=2e-4)
+
+
+# ---- zigzag layout ----
+
+def zz_ring_fn(mesh, block=16):
+    fn = shard_map(
+        functools.partial(ring_flash_attention, causal=True,
+                          block_q=block, block_k=block, interpret=True,
+                          layout="zigzag"),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, SEQ_AXIS),) * 3,
+        out_specs=P(DATA_AXIS, SEQ_AXIS),
+        check_vma=False,
+    )
+    return fn
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_zigzag_ring_flash_matches_dense(devices8, sp):
+    """Zigzag-laid-out inputs through the zigzag ring == dense attention
+    on the original order, after unshuffling."""
+    from pytorch_distributed_tpu.parallel.sequence import (
+        zigzag_shard,
+        zigzag_unshard,
+    )
+
+    mesh = make_mesh(devices8[: 2 * sp], data_parallel=2, seq_parallel=sp)
+    q, k, v = qkv()
+    ref = dense_attention(q, k, v, causal=True)
+    sh = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    qz, kz, vz = (
+        jax.device_put(zigzag_shard(x, sp), sh) for x in (q, k, v)
+    )
+    out = zigzag_unshard(zz_ring_fn(mesh)(qz, kz, vz), sp)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_zigzag_ring_flash_grads_match_dense(devices8):
+    from pytorch_distributed_tpu.parallel.sequence import zigzag_shard
+
+    sp = 4
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=sp)
+    q, k, v = qkv(seed=5)
+    sh = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    fn = zz_ring_fn(mesh)
+
+    def loss_zz(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    g_zz = jax.grad(loss_zz, argnums=(0, 1, 2))(
+        *(jax.device_put(zigzag_shard(x, sp), sh) for x in (q, k, v))
+    )
+    from pytorch_distributed_tpu.parallel.sequence import zigzag_unshard
+
+    for a, b in zip(g_ref, g_zz):
+        np.testing.assert_allclose(
+            np.asarray(zigzag_unshard(b, sp)), np.asarray(a),
+            rtol=1e-4, atol=1e-4,
+        )
+
+
+def test_zigzag_validations():
+    q, k, v = qkv(l=32)
+    with pytest.raises(ValueError, match="non-causal"):
+        ring_flash_attention(q, k, v, causal=False, layout="zigzag",
+                             interpret=True)
+    with pytest.raises(ValueError, match="unknown layout"):
+        ring_flash_attention(q, k, v, causal=True, layout="striped",
+                             interpret=True)
