@@ -29,27 +29,37 @@ from pytorch_distributed_tpu.parallel.mesh import MODEL_AXIS
 
 
 def gpipe(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[..., Any],
     stage_params: Any,
     microbatches: jax.Array,
     *,
     axis: str = MODEL_AXIS,
     remat: bool = True,
-) -> jax.Array:
+    has_aux: bool = False,
+):
     """Run microbatches through the stage pipeline (call under shard_map).
 
     Args:
-      stage_fn: ``(stage_params, x) -> y`` — one stage's computation; every
-        stage must map the same activation shape to itself (uniform-width
-        pipeline, e.g. a slice of transformer blocks).
+      stage_fn: ``(stage_params, x, mb_idx) -> y`` (or ``-> (y, aux)`` with
+        ``has_aux``) — one stage's computation; every stage must map the
+        same activation shape to itself (uniform-width pipeline, e.g. a
+        slice of transformer blocks). ``mb_idx`` is the index of the
+        microbatch this tick computes on THIS stage (clipped during
+        warm-up/drain) — derive dropout rngs from it so the pipelined run
+        reproduces the sequential reference's masks exactly.
       stage_params: THIS stage's parameters (the local shard of a
         stage-stacked tree).
       microbatches: ``[M, ...]`` — the full input, identical on every stage
         (stage 0 consumes it; others ignore theirs).
+      has_aux: ``stage_fn`` also returns a scalar auxiliary loss (e.g. MoE
+        load balancing); contributions from warm-up/drain ticks — garbage
+        activations — are masked OUT (their gradients too), and the summed
+        real-tick aux is returned alongside the outputs.
 
-    Returns: ``[M, ...]`` outputs, VALID ON THE LAST STAGE (other stages
-    hold garbage from their position in the ring) — select stage S-1's
-    copy, e.g. via ``jax.lax.ppermute`` broadcast or an outer psum-mask.
+    Returns: ``[M, ...]`` outputs (with ``has_aux``: ``(outputs, aux)``),
+    VALID ON THE LAST STAGE (other stages hold garbage from their position
+    in the ring; ``aux`` is valid on EVERY stage for its own real ticks) —
+    select stage S-1's output copy via ``last_stage_value`` or a psum-mask.
     """
     s = jax.lax.psum(1, axis)
     my = jax.lax.axis_index(axis)
@@ -61,12 +71,19 @@ def gpipe(
     perm = [(i, (i + 1) % s) for i in range(s)]
 
     def tick(carry, t):
-        incoming, outputs = carry
+        incoming, outputs, aux_acc = carry
         # Stage 0 feeds microbatch t while t < M; later stages consume what
-        # arrived from their predecessor last tick.
+        # arrived from their predecessor last tick. Stage ``my`` works on
+        # microbatch t - my when my <= t < my + M (else a garbage tick).
         feed = microbatches[jnp.clip(t, 0, m - 1)]
         x = jnp.where(my == 0, feed, incoming)
-        y = stage_fn(stage_params, x)
+        mb_idx = jnp.clip(t - my, 0, m - 1)
+        if has_aux:
+            y, aux = stage_fn(stage_params, x, mb_idx)
+            real = ((t >= my) & (t < my + m)).astype(aux.dtype)
+            aux_acc = aux_acc + real * aux
+        else:
+            y = stage_fn(stage_params, x, mb_idx)
         # The last stage banks its result at output slot t - (S-1) (valid
         # once the pipeline is full).
         slot = jnp.clip(t - (s - 1), 0, m - 1)
@@ -75,7 +92,7 @@ def gpipe(
         banked = jnp.where(valid, y, current)
         outputs = jax.lax.dynamic_update_index_in_dim(outputs, banked, slot, 0)
         incoming = jax.lax.ppermute(y, axis, perm)
-        return (incoming, outputs), None
+        return (incoming, outputs, aux_acc), None
 
     if remat:
         tick = jax.checkpoint(tick)
@@ -83,9 +100,10 @@ def gpipe(
     init = (
         jnp.zeros(mb_shape, microbatches.dtype),
         jnp.zeros((m,) + mb_shape, microbatches.dtype),
+        jnp.zeros((), jnp.float32),
     )
-    (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(m + s - 1))
-    return outputs
+    (_, outputs, aux), _ = jax.lax.scan(tick, init, jnp.arange(m + s - 1))
+    return (outputs, aux) if has_aux else outputs
 
 
 def last_stage_value(x: jax.Array, axis: str = MODEL_AXIS) -> jax.Array:

@@ -24,8 +24,17 @@ block stack through it:
   sequentially (no mesh) — tests/test_pp_lm.py asserts loss and parameter
   trajectories match the pipelined run.
 
-Dropout is rejected for now (rng plumbing through the gpipe scan is a
-follow-up); use the (data, seq) path in ``train.lm`` for dropout training.
+Composability (round-3): dropout threads per-(step, stage, microbatch,
+data-shard) rngs through the gpipe scan, reproducing the sequential
+reference's masks bit-for-bit (and therefore resume parity); TP lives
+INSIDE stages when the mesh carries a separate ``stage`` axis (stage
+params stack-shard on ``stage`` AND Megatron-shard on ``model`` via
+``TRANSFORMER_TP_RULES``); MoE blocks run inside stages with their
+load-balancing aux losses accumulated only over REAL pipeline ticks
+(garbage warm-up/drain contributions masked, gradients included). MoE
+expert parallelism (expert_axis) under PP stays guarded: EP rides the
+data axis, and dispatch inside a pipeline tick across the data axis is
+untested — experts replicate within a stage instead.
 """
 
 from __future__ import annotations
@@ -62,15 +71,29 @@ class PPEmbed(nn.Module):
 
 
 class PPStage(nn.Module):
-    """One pipeline stage: ``layers_per_stage`` real transformer Blocks."""
+    """One pipeline stage: ``layers_per_stage`` real transformer Blocks.
+
+    ``use_moe`` follows the global ``moe_every`` pattern; stage stacking
+    requires the pattern to repeat identically per stage
+    (``layers_per_stage % moe_every == 0`` — checked at state creation),
+    so the within-stage layer index determines it.
+    """
 
     config: TransformerConfig
     layers_per_stage: int
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, x):
+        cfg = self.config
         for j in range(self.layers_per_stage):
-            x = Block(self.config, name=f"layer{j}")(x, 0)
+            use_moe = bool(cfg.n_experts) and (
+                j % cfg.moe_every == cfg.moe_every - 1
+            )
+            x = Block(
+                cfg, use_moe=use_moe, deterministic=self.deterministic,
+                name=f"layer{j}",
+            )(x, 0)
         return x
 
 
@@ -102,32 +125,35 @@ def create_pp_lm_state(
         raise ValueError(
             f"num_layers {config.num_layers} not divisible by n_stages {n_stages}"
         )
-    if config.dropout:
+    if config.n_experts and config.expert_axis is not None:
         raise NotImplementedError(
-            "pipeline-parallel training does not thread dropout rngs yet; "
-            "set dropout=0.0 or use the (data, seq) LM path"
-        )
-    if config.model_axis is not None or config.tp_size > 1:
-        raise ValueError(
-            "PP repurposes the 'model' mesh axis as the STAGE axis; a "
-            "TP-enabled config (model_axis/tp_size) would psum activations "
-            "across pipeline stages and train on garbage. Unset model_axis "
-            "for PP (TP-within-PP needs a fourth mesh axis — not built yet)."
-        )
-    if config.n_experts:
-        raise NotImplementedError(
-            "MoE blocks inside pipeline stages are untested under PP; use "
-            "the (data, seq) LM path for expert parallelism"
+            "MoE EXPERT PARALLELISM under PP is unsupported (EP rides the "
+            "data axis; dispatch across it inside a pipeline tick is "
+            "untested). Clear expert_axis/ep_size — experts then replicate "
+            "within each stage, which PP supports."
         )
     lps = config.num_layers // n_stages
+    if config.n_experts and lps % config.moe_every:
+        raise ValueError(
+            f"stage stacking needs an identical MoE pattern per stage: "
+            f"layers_per_stage {lps} must be divisible by moe_every "
+            f"{config.moe_every}"
+        )
     length = init_len or min(config.max_seq_len, 128)
     tokens = jnp.zeros((1, length), jnp.int32)
 
-    embed = PPEmbed(config)
+    # Init twin with TP collectives off: parameter shapes are GLOBAL (the
+    # TP convention throughout — placement shards), and init needs no mesh
+    # axis in scope. Same trick as train.lm.create_lm_state.
+    import dataclasses
+
+    init_cfg = dataclasses.replace(config, model_axis=None, tp_size=1)
+
+    embed = PPEmbed(init_cfg)
     e_vars = embed.init(rng, tokens)
     x = embed.apply(e_vars, tokens)
 
-    stage = PPStage(config, lps)
+    stage = PPStage(init_cfg, lps)
     stage_vars = [
         stage.init(jax.random.fold_in(rng, s), x)["params"]
         for s in range(n_stages)
@@ -155,15 +181,53 @@ def create_pp_lm_state(
     )
 
 
-def pp_state_specs(state: TrainState, axis: str = MODEL_AXIS) -> TrainState:
-    """Spec tree: stage stacks sharded P(axis) on dim 0, rest replicated."""
-    from pytorch_distributed_tpu.parallel.tensor import opt_state_specs
+def pp_state_specs(
+    state: TrainState, axis: str = MODEL_AXIS, config=None
+) -> TrainState:
+    """Spec tree: stage stacks sharded P(axis) on dim 0, rest replicated.
+
+    With a TP-enabled ``config`` (model_axis set, != ``axis``), stage
+    leaves COMPOSE both placements: the stacked dim shards on the stage
+    axis and the Megatron dims on the model axis per
+    ``TRANSFORMER_TP_RULES`` (shifted right by the stack dim)."""
+    from pytorch_distributed_tpu.parallel.tensor import (
+        opt_state_specs,
+        path_str,
+    )
+    from pytorch_distributed_tpu.train.lm import TRANSFORMER_TP_RULES
+
+    use_tp = (
+        config is not None
+        and getattr(config, "model_axis", None) is not None
+        and config.tp_size > 1
+    )
+    if use_tp and config.model_axis == axis:
+        raise ValueError(
+            f"TP-within-PP needs distinct axes: stage axis {axis!r} vs "
+            f"config.model_axis {config.model_axis!r}"
+        )
+
+    def _stage_spec(path, leaf):
+        tail = (None,) * (leaf.ndim - 1)
+        if use_tp:
+            import re
+
+            p = path_str(path)
+            for pat, spec in TRANSFORMER_TP_RULES:
+                if re.search(pat, p):
+                    # rules are written against the canonical MODEL_AXIS
+                    # name; remap to the config's axis
+                    tail = tuple(
+                        config.model_axis if part == MODEL_AXIS else part
+                        for part in spec
+                    )
+                    break
+        return P(*((axis,) + tail))
 
     param_specs = {
         "embed": jax.tree.map(lambda _: P(), state.params["embed"]),
-        "stages": jax.tree.map(
-            lambda leaf: P(*((axis,) + (None,) * (leaf.ndim - 1))),
-            state.params["stages"],
+        "stages": jax.tree_util.tree_map_with_path(
+            _stage_spec, state.params["stages"]
         ),
         "head": jax.tree.map(lambda _: P(), state.params["head"]),
     }
@@ -176,7 +240,8 @@ def pp_state_specs(state: TrainState, axis: str = MODEL_AXIS) -> TrainState:
     )
 
 
-def shard_pp_state(mesh: Mesh, state: TrainState, axis: str = MODEL_AXIS):
+def shard_pp_state(mesh: Mesh, state: TrainState, axis: str = MODEL_AXIS,
+                   config=None):
     from pytorch_distributed_tpu.parallel.mesh import specs_to_shardings
 
     n_stages = jax.tree.leaves(state.params["stages"])[0].shape[0]
@@ -185,13 +250,22 @@ def shard_pp_state(mesh: Mesh, state: TrainState, axis: str = MODEL_AXIS):
             f"state has {n_stages} stages but mesh's {axis!r} axis is "
             f"{mesh.shape[axis]} — they must match"
         )
-    specs = pp_state_specs(state, axis)
+    specs = pp_state_specs(state, axis, config=config)
     return jax.device_put(state, specs_to_shardings(mesh, specs)), specs
 
 
-def _pp_loss(config, lps, params, batch, n_microbatches, axis):
+def pp_dropout_key(base_key, stage_idx, mb_idx):
+    """The ONE dropout-key derivation both the pipelined and the sequential
+    reference steps use: fold (stage, microbatch) into the step's base key.
+    Shared so bit-parity (incl. across suspend/resume) is by construction."""
+    return jax.random.fold_in(jax.random.fold_in(base_key, stage_idx), mb_idx)
+
+
+def _pp_loss(config, lps, params, batch, n_microbatches, axis,
+             dropout_key=None):
     """Stage-local CE sum over this shard's pipeline output (real only on
-    the last stage; the caller masks)."""
+    the last stage; the caller masks) plus this stage's REAL-tick MoE aux
+    losses."""
     tokens = batch["tokens"]
     b, l = tokens.shape
     if b % n_microbatches:
@@ -201,14 +275,24 @@ def _pp_loss(config, lps, params, batch, n_microbatches, axis):
     x = PPEmbed(config).apply({"params": params["embed"]}, tokens)
     mb = x.reshape(n_microbatches, b // n_microbatches, l, x.shape[-1])
 
-    stage = PPStage(config, lps)
+    stage = PPStage(config, lps, deterministic=dropout_key is None)
     # shard_map delivers this stage's [1, ...] slice of the stack
     my_stage = jax.tree.map(lambda s: s[0], params["stages"])
+    stage_idx = jax.lax.axis_index(axis)
 
-    def stage_fn(sp, act):
-        return stage.apply({"params": sp}, act)
+    def stage_fn(sp, act, mb_idx):
+        rngs = None
+        if dropout_key is not None:
+            rngs = {"dropout": pp_dropout_key(dropout_key, stage_idx, mb_idx)}
+        out, mutated = stage.apply(
+            {"params": sp}, act, rngs=rngs, mutable=["aux_loss", "moe_stats"]
+        )
+        aux = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree.leaves(mutated.get("aux_loss", {})):
+            aux = aux + leaf
+        return out, aux
 
-    outs = gpipe(stage_fn, my_stage, mb, axis=axis)
+    outs, aux = gpipe(stage_fn, my_stage, mb, axis=axis, has_aux=True)
     outs = outs.reshape(b, l, x.shape[-1])
     logits = PPHead(config).apply({"params": params["head"]}, outs)
     per_tok = cross_entropy_loss(
@@ -217,7 +301,7 @@ def _pp_loss(config, lps, params, batch, n_microbatches, axis):
         reduction="none",
     )
     w = batch["weights"].reshape(-1)
-    return jnp.sum(per_tok * w)
+    return jnp.sum(per_tok * w), aux
 
 
 def make_pp_lm_train_step(
@@ -227,11 +311,19 @@ def make_pp_lm_train_step(
     n_microbatches: int = 4,
     data_axis: str = DATA_AXIS,
     axis: str = MODEL_AXIS,
+    dropout_seed: int = 0,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
-    """Compiled PP train step over a (data, model) mesh.
+    """Compiled PP train step over a (data, stage[, model]) mesh.
 
     ``batch``: {"tokens", "labels", "weights"} [B, L] sharded P(data) —
-    every stage in a data-replica group sees the same tokens.
+    every stage in a data-replica group sees the same tokens. With a
+    TP-enabled config (``model_axis`` set, distinct from ``axis``), the
+    Megatron collectives run INSIDE each stage over the model axis while
+    activations travel the stage ring — pass a mesh carrying both axes
+    and specs from ``pp_state_specs(state, axis, config=config)``.
+    Dropout (``config.dropout > 0``) derives per-(step, data-shard, stage,
+    microbatch) keys via ``pp_dropout_key`` — identical to the sequential
+    reference, so trajectories (and resume) stay bit-par.
     """
     n_stages = mesh.shape[axis]
     if config.num_layers % n_stages:
@@ -239,16 +331,46 @@ def make_pp_lm_train_step(
             f"num_layers {config.num_layers} not divisible by "
             f"{axis!r}={n_stages}"
         )
+    if config.model_axis is not None:
+        if config.model_axis == axis:
+            raise ValueError(
+                f"TP-within-PP needs distinct mesh axes (stage {axis!r} vs "
+                f"model {config.model_axis!r}); a shared axis would psum "
+                "activations across pipeline stages and train on garbage"
+            )
+        if config.model_axis not in mesh.shape:
+            raise ValueError(
+                f"config.model_axis {config.model_axis!r} not in mesh axes "
+                f"{tuple(mesh.shape)}"
+            )
+        if mesh.shape[config.model_axis] != config.tp_size:
+            raise ValueError(
+                f"mesh {config.model_axis!r} size "
+                f"{mesh.shape[config.model_axis]} != tp_size {config.tp_size}"
+            )
     lps = config.num_layers // n_stages
+    use_dropout = config.dropout > 0.0
 
     def _local_step(state: TrainState, batch: dict):
         global_count = jax.lax.psum(jnp.sum(batch["weights"]), data_axis)
         n_stages_rt = jax.lax.psum(1, axis)
         my_stage = jax.lax.axis_index(axis)
+        n_data = jax.lax.psum(1, data_axis)
+        dropout_key = None
+        if use_dropout:
+            # per-(step, data shard); stage/microbatch folded inside the
+            # pipeline (pp_dropout_key). Model-axis replicas share keys.
+            dropout_key = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.key(dropout_seed), state.step
+                ),
+                jax.lax.axis_index(data_axis),
+            )
 
         def loss_fn(params):
-            local_sum = _pp_loss(
-                config, lps, params, batch, n_microbatches, axis
+            local_sum, aux = _pp_loss(
+                config, lps, params, batch, n_microbatches, axis,
+                dropout_key=dropout_key,
             )
             # Mask LOCALLY — no psum inside the differentiated function (a
             # param-dependent psum transposes to another psum and scales
@@ -256,9 +378,15 @@ def make_pp_lm_train_step(
             # the last stage's pipeline output is real; the zero mask on
             # other stages kills their garbage branches' gradients, while
             # every stage still receives its true gradient through the
-            # transposed ppermute ring from the last stage's loss.
+            # transposed ppermute ring from the last stage's loss. MoE aux
+            # losses are REAL on every stage (their garbage ticks already
+            # masked inside gpipe) and enter as this shard's share of the
+            # data-mean of the stage-summed, microbatch-averaged total.
             mask = (my_stage == n_stages_rt - 1).astype(jnp.float32)
-            return mask * local_sum / jnp.maximum(global_count, 1.0)
+            return (
+                mask * local_sum / jnp.maximum(global_count, 1.0)
+                + aux / (n_microbatches * n_data)
+            )
 
         # Each (data, stage) shard's loss_fn is its SHARE of the global
         # mean (nonzero only on last stages), so loss and gradients combine
@@ -296,33 +424,62 @@ def make_pp_reference_step(
     config: TransformerConfig,
     n_stages: int,
     tx,
+    n_microbatches: int = 1,
+    dropout_seed: int = 0,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Sequential single-device step over the SAME stacked params — the
     golden reference the pipelined step must match bit-for-bit (up to fp
-    reassociation)."""
+    reassociation). Microbatched like the pipeline (``n_microbatches``):
+    dropout keys come from the shared ``pp_dropout_key`` derivation and
+    MoE routing/aux see the same per-microbatch token groups, so the
+    comparison is exact, not just statistical."""
     if config.num_layers % n_stages:
         raise ValueError("num_layers % n_stages != 0")
     lps = config.num_layers // n_stages
+    use_dropout = config.dropout > 0.0
 
     @jax.jit
     def step(state: TrainState, batch: dict):
         count = jnp.sum(batch["weights"])
+        base_key = None
+        if use_dropout:
+            base_key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(dropout_seed), state.step),
+                0,  # data shard 0 — the single-device reference
+            )
 
         def loss_fn(params):
             x = PPEmbed(config).apply({"params": params["embed"]}, batch["tokens"])
-            stage = PPStage(config, lps)
-            for s in range(n_stages):
-                sp = jax.tree.map(lambda leaf: leaf[s], params["stages"])
-                x = stage.apply({"params": sp}, x)
+            b, l, e = x.shape
+            mb = x.reshape(n_microbatches, b // n_microbatches, l, e)
+            stage = PPStage(config, lps, deterministic=not use_dropout)
+            aux_total = jnp.zeros((), jnp.float32)
+            outs = []
+            for m in range(n_microbatches):
+                act = mb[m]
+                for s in range(n_stages):
+                    sp = jax.tree.map(lambda leaf: leaf[s], params["stages"])
+                    rngs = None
+                    if use_dropout:
+                        rngs = {"dropout": pp_dropout_key(base_key, s, m)}
+                    act, mutated = stage.apply(
+                        {"params": sp}, act, rngs=rngs,
+                        mutable=["aux_loss", "moe_stats"],
+                    )
+                    for leaf in jax.tree.leaves(mutated.get("aux_loss", {})):
+                        aux_total = aux_total + leaf
+                outs.append(act)
+            x = jnp.concatenate(outs, axis=0)
             logits = PPHead(config).apply({"params": params["head"]}, x)
             per_tok = cross_entropy_loss(
                 logits.reshape(-1, logits.shape[-1]),
                 batch["labels"].reshape(-1),
                 reduction="none",
             )
-            return jnp.sum(per_tok * batch["weights"].reshape(-1)) / jnp.maximum(
+            ce = jnp.sum(per_tok * batch["weights"].reshape(-1)) / jnp.maximum(
                 count, 1.0
             )
+            return ce + aux_total / n_microbatches
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)

@@ -14,7 +14,7 @@ D = 16
 STAGES = 4
 
 
-def stage_fn(p, x):
+def stage_fn(p, x, mb_idx=0):
     return jax.nn.relu(x @ p["w"] + p["b"])
 
 
