@@ -259,6 +259,23 @@ def main() -> None:
             record.update(bench_data_pipeline())
         except Exception as e:
             record["data_pipeline_error"] = str(e)[:200]
+    if not tiny and os.environ.get("BENCH_CKPT", "1") == "1":
+        try:
+            import subprocess
+            import sys as _sys
+
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("XLA_FLAGS",)}
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [_sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_checkpoint.py")],
+                env=env, capture_output=True, text=True, timeout=600,
+            )
+            record.update(json.loads(out.stdout.strip().splitlines()[-1]))
+        except Exception as e:
+            record["ckpt_bench_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_ATTN", "1") == "1":
         try:
             record.update(bench_flash_attention())

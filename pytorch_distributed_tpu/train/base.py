@@ -35,11 +35,13 @@ class SuspendableTrainer:
         pass
 
     def _payload(self, epoch: int, step: int) -> dict:
-        """Checkpoint payload with every array gathered to host.
+        """LEGACY single-file payload: every array gathered to host.
 
         ``gather_global`` is a collective for cross-process-sharded states,
         so this MUST run on every process together; only the disk write is
-        rank-0-gated (``restnet_ddp.py:36,145``)."""
+        rank-0-gated (``restnet_ddp.py:36,145``). The default save path is
+        now ``_payload_live`` + ``save_latest_sharded`` (no gather); this
+        remains for the single-file interchange format."""
         from pytorch_distributed_tpu.utils.checkpoint import gather_global
 
         payload = {"state": gather_global(self.state), "epoch": epoch,
@@ -47,19 +49,40 @@ class SuspendableTrainer:
         payload.update(self._extra_payload())
         return payload
 
+    def _payload_live(self, epoch: int, step: int) -> dict:
+        """Payload with the state's live (device, possibly cross-process
+        sharded) arrays — for ``save_sharded``, which writes each process's
+        blocks from its own shards. NO gather, no full-state host copy."""
+        payload = {"state": self.state, "epoch": epoch, "step": step}
+        payload.update(self._extra_payload())
+        return payload
+
+    def _state_shardings(self):
+        if self.state_specs is not None:
+            return mesh_lib.specs_to_shardings(self.mesh, self.state_specs)
+        return jax.tree.map(
+            lambda _: mesh_lib.replicated_sharding(self.mesh), self.state
+        )
+
     def try_resume(self) -> bool:
-        """Restore from ``latest.ckpt`` if present (``restnet_ddp.py:127-132``)."""
+        """Restore from ``latest.ckpt`` if present (``restnet_ddp.py:127-132``).
+
+        Sharded directories restore shard-wise (each process reads only the
+        blocks its devices need); legacy single files restore via the old
+        full-numpy path."""
         if not self.ckpt.has_latest():
             return False
-        restored = self.ckpt.load_latest(self._payload(0, 0))
-        if self.state_specs is not None:
-            self.state = jax.device_put(
-                restored["state"],
-                mesh_lib.specs_to_shardings(self.mesh, self.state_specs),
-            )
+        if self.ckpt.latest_is_sharded():
+            template = self._payload_live(0, 0)
+            state_sh = self._state_shardings()
+            shardings = jax.tree.map(lambda _: False, template)
+            shardings["state"] = state_sh
+            restored = self.ckpt.load_latest_sharded(template, shardings)
+            self.state = jax.device_put(restored["state"], state_sh)
         else:
+            restored = self.ckpt.load_latest(self._payload(0, 0))
             self.state = jax.device_put(
-                restored["state"], mesh_lib.replicated_sharding(self.mesh)
+                restored["state"], self._state_shardings()
             )
         self.start_epoch = int(restored["epoch"])
         self.start_step = int(restored["step"])
@@ -94,12 +117,13 @@ class SuspendableTrainer:
             )
         if not suspended:
             return
-        payload = self._payload(epoch, step + 1)  # collective: all ranks
-        if jax.process_index() == 0:
-            self.ckpt.save_latest(payload)
-            rank0_print(
-                f"suspend: saved {self.ckpt.latest_path} at epoch {epoch} "
-                f"step {step}"
-            )
+        # Sharded save: EVERY process writes its own blocks (no gather, no
+        # full-state host copy on any rank); rank 0 adds the manifest; the
+        # save's internal barrier guarantees all files landed before yield.
+        self.ckpt.save_latest_sharded(self._payload_live(epoch, step + 1))
+        rank0_print(
+            f"suspend: saved {self.ckpt.latest_path} at epoch {epoch} "
+            f"step {step}"
+        )
         self.ckpt.wait()
         self.watcher.go_suspend()

@@ -261,9 +261,8 @@ class LMTrainer(SuspendableTrainer):
             )
             if summary["ppl"] < self.best_ppl:
                 self.best_ppl = summary["ppl"]
-                payload = self._payload(epoch + 1, 0)  # collective
-                if jax.process_index() == 0:
-                    self.ckpt.save_best(payload)
+                # sharded: all ranks write their blocks, no full gather
+                self.ckpt.save_best_sharded(self._payload_live(epoch + 1, 0))
                 rank0_print(f"new best ppl {self.best_ppl:.3f}, saved best.ckpt")
             self.metrics_log.log(kind="val", epoch=epoch,
                                  epoch_s=time.time() - t0, **summary)

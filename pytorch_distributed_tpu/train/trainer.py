@@ -282,9 +282,8 @@ class Trainer(SuspendableTrainer):
             )
             if summary["acc1"] > self.best_acc:
                 self.best_acc = summary["acc1"]
-                payload = self._payload(epoch + 1, 0)  # collective: all ranks
-                if jax.process_index() == 0:
-                    self.ckpt.save_best(payload)
+                # sharded: all ranks write their blocks, no full gather
+                self.ckpt.save_best_sharded(self._payload_live(epoch + 1, 0))
                 rank0_print(f"new best acc1 {self.best_acc:.2f}, saved best.ckpt")
             epoch_s = time.time() - t0
             rank0_print(

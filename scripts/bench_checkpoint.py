@@ -1,0 +1,89 @@
+"""Checkpoint save/restore wall-clock at the 135M-param LM size.
+
+Measures the sharded checkpoint path (utils.checkpoint.save_sharded /
+load_sharded) on a full AdamW TrainState: params + 2 moments, fp32 —
+~1.6 GB. Runs on the CPU backend on purpose: through this environment's
+tunneled TPU runtime the device→host link is ~24 MB/s (PERF_NOTES.md §1),
+so an on-chip run times the tunnel, not the checkpoint code; on a real
+TPU VM the device→host hop rides PCIe at GB/s and the serialize+disk cost
+measured here dominates. Emits one JSON line:
+
+  {"ckpt_params_m": ..., "ckpt_bytes_mb": ..., "ckpt_save_s": ...,
+   "ckpt_restore_s": ..., "ckpt_mb_per_s": ...}
+
+Usage: python scripts/bench_checkpoint.py [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from pytorch_distributed_tpu.models.transformer import TransformerConfig
+    from pytorch_distributed_tpu.ops.optim import build_optimizer
+    from pytorch_distributed_tpu.train.lm import create_lm_state
+    from pytorch_distributed_tpu.utils.checkpoint import (
+        load_sharded,
+        save_sharded,
+    )
+
+    small = "--small" in sys.argv
+    cfg = TransformerConfig(
+        vocab_size=32000 if not small else 1024,
+        num_layers=12 if not small else 2,
+        num_heads=12 if not small else 2,
+        embed_dim=768 if not small else 64,
+        max_seq_len=1024 if not small else 64,
+        dtype=jnp.float32,
+    )
+    tx = build_optimizer("adamw", 1e-4)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=64)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    payload = {"state": state, "epoch": 1, "step": 100, "best_ppl": 12.5}
+    total_bytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(payload)
+        if hasattr(x, "dtype")
+    )
+
+    d = tempfile.mkdtemp(prefix="ckpt_bench_")
+    try:
+        t0 = time.perf_counter()
+        save_sharded(os.path.join(d, "latest.ckpt"), payload)
+        save_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        back = load_sharded(os.path.join(d, "latest.ckpt"), payload)
+        # touch a leaf so lazy work can't hide
+        float(np.asarray(jax.tree.leaves(back["state"].params)[0]).ravel()[0])
+        restore_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    print(json.dumps({
+        "ckpt_params_m": round(n_params / 1e6, 1),
+        "ckpt_bytes_mb": round(total_bytes / 2**20, 1),
+        "ckpt_save_s": round(save_s, 2),
+        "ckpt_restore_s": round(restore_s, 2),
+        "ckpt_mb_per_s": round(total_bytes / 2**20 / max(save_s, 1e-9), 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -169,7 +169,13 @@ def test_lm_trainer_two_process_tp_sharded_checkpoint(tmp_path):
     assert r0["param_l1"] == r1["param_l1"]
     assert r0["val_loss"] == r1["val_loss"]
     assert r0["final_step"] == r1["final_step"] > 0
-    assert os.path.exists(os.path.join(save, "best.ckpt"))
+    assert r0["sharded_ckpt_ok"] and r1["sharded_ckpt_ok"]
+    assert os.path.isdir(os.path.join(save, "best.ckpt"))
+    assert os.path.isdir(os.path.join(save, "latest.ckpt"))
+    for r in (0, 1):
+        assert os.path.exists(
+            os.path.join(save, "latest.ckpt", f"shard-{r:05d}.npz")
+        )
 
 
 def test_suspend_sync_gt_one_defers_without_deadlock(tmp_path):
