@@ -443,8 +443,8 @@ def ring_flash_attention(
     axis: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool = False,
     layout: str = "contiguous",
 ) -> jax.Array:

@@ -38,17 +38,35 @@ from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 def difftime(f, k1=10, k2=110):
     """Slope of wall time vs in-jit trip count: removes the fixed ~95 ms
     tunnel round-trip and dispatch costs. ``f(n)`` must run n chained
-    iterations inside one jit (dynamic trip count → single compile)."""
-    float(f(k1))  # compile + warm
-    ts = {}
-    for k in (k1, k2):
+    iterations inside one jit (dynamic trip count → single compile).
+
+    Guarded against sub-resolution timings (the r2 bench shipped a 0.0 ms
+    / 7.5M-TFLOP row from exactly this failure): the trip-count delta is
+    doubled until the measured window exceeds 20 ms, and a slope at the
+    floor raises instead of publishing garbage."""
+
+    def measure(k):
         best = 1e9
         for _ in range(3):
             t0 = time.perf_counter()
             float(f(k))
             best = min(best, time.perf_counter() - t0)
-        ts[k] = best
-    return max((ts[k2] - ts[k1]) / (k2 - k1), 1e-9)
+        return best
+
+    float(f(k1))  # compile + warm
+    t1 = measure(k1)
+    for _ in range(8):
+        t2 = measure(k2)
+        if t2 - t1 > 0.02:
+            break
+        k2 *= 2  # window too small for the clock/tunnel noise: widen
+    slope = (t2 - t1) / (k2 - k1)
+    if slope <= 1e-7:
+        raise RuntimeError(
+            f"sub-resolution timing (window {t2 - t1:.4f}s over {k2 - k1} "
+            "trips) — refusing to report a garbage TFLOP/s number"
+        )
+    return slope
 
 
 def attn_flops(b, h, l, d, causal):
