@@ -393,7 +393,7 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 1024,
-    interpret: bool = False,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """FlashAttention: ``softmax(QKᵀ·scale)V`` tiled through VMEM.
 
@@ -410,6 +410,10 @@ def flash_attention(
     the sequence length, so short sequences degrade gracefully.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if interpret is None:
+        # Mosaic kernels need the Pallas interpreter off-TPU; auto-detect
+        # so CPU tests/dryruns run the same call sites unmodified.
+        interpret = jax.default_backend() == "cpu"
     lq, lk = q.shape[1], k.shape[1]
     block_q = min(block_q, max(lq, 1))
     block_k = min(block_k, max(lk, 1))

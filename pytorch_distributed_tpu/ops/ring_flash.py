@@ -445,7 +445,7 @@ def ring_flash_attention(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 1024,
-    interpret: bool = False,
+    interpret: bool | None = None,
     layout: str = "contiguous",
 ) -> jax.Array:
     """Ring attention with Pallas flash kernels per visiting shard (call
@@ -462,6 +462,8 @@ def ring_flash_attention(
     absolute order).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"  # see flash_attention
     lq, lk = q.shape[1], k.shape[1]
     if lq != lk:
         raise ValueError(
