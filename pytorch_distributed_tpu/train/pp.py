@@ -308,12 +308,20 @@ def make_pp_lm_train_step(
     mesh: Mesh,
     config: TransformerConfig,
     state_specs: TrainState,
-    n_microbatches: int = 4,
+    n_microbatches: int = 8,
     data_axis: str = DATA_AXIS,
     axis: str = MODEL_AXIS,
     dropout_seed: int = 0,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Compiled PP train step over a (data, stage[, model]) mesh.
+
+    ``n_microbatches`` defaults to 8 from measurement (scripts/bench_pp.py,
+    4 stages, 8-device mesh): the step-time curve tracks the GPipe tick
+    model (M+S-1 ticks; bubble (S-1)/(M+S-1)) and flattens at M=8 —
+    91.7 ms vs 91.3 at M=16 vs 121.7 at the old default of 4 — because
+    per-tick overhead eats the shrinking bubble win beyond that. Metrics
+    include the analytic ``pp_bubble_frac`` for the configured M/S so the
+    JSONL log records the schedule's efficiency.
 
     ``batch``: {"tokens", "labels", "weights"} [B, L] sharded P(data) —
     every stage in a data-replica group sees the same tokens. With a
@@ -408,7 +416,13 @@ def make_pp_lm_train_step(
         new_state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt_state
         )
-        return new_state, {"loss": loss, "tokens": global_count}
+        return new_state, {
+            "loss": loss,
+            "tokens": global_count,
+            "pp_bubble_frac": jnp.float32(
+                (n_stages - 1) / (n_microbatches + n_stages - 1)
+            ),
+        }
 
     sharded = shard_map(
         _local_step,
