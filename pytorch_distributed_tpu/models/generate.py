@@ -11,9 +11,10 @@ against the cached K/V only (O(L) per token). One compiled program total.
 mask, and the positional embedding all derive from it, so a stale cache
 and a wrong offset cannot silently disagree.
 
-Single-device/replicated params, dense-attention math (the cache IS the
-global sequence, so no ring is needed at decode time). Deterministic under
-a fixed rng key.
+Dense-attention math (the cache IS the global sequence, so no ring is
+needed at decode time); ``generate`` runs with replicated params,
+``generate_tp`` shards the decode matmuls and the KV cache over the model
+axis (Megatron layout). Deterministic under a fixed rng key.
 """
 
 from __future__ import annotations
@@ -59,6 +60,109 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
+def _generate_core(config, params, prompt, rng, max_new_tokens, temperature,
+                   top_k):
+    """The prefill + scan decode body; runs replicated or (under shard_map
+    with a TP config) with Megatron collectives inside each apply."""
+    model = TransformerLM(config)
+    b, l_prompt = prompt.shape
+    logits, variables = model.apply(
+        {"params": params},
+        prompt,
+        position_offset=0,
+        prefill=True,
+        mutable=["cache"],
+    )
+    cache = variables["cache"]
+    last_logits = logits[:, -1]
+
+    def step(cache, token, pos):
+        logits, variables = model.apply(
+            {"params": params, "cache": cache},
+            token[:, None],
+            position_offset=pos,
+            decode=True,
+            mutable=["cache"],
+        )
+        return variables["cache"], logits[:, 0]
+
+    def decode_body(carry, rng_step):
+        cache, pos, logits = carry
+        token = _sample(logits, rng_step, temperature, top_k)
+        cache, next_logits = step(cache, token, pos)
+        return (cache, pos + 1, next_logits), token
+
+    rngs = jax.random.split(rng, max_new_tokens)
+    _, tokens = jax.lax.scan(
+        decode_body,
+        (cache, jnp.asarray(l_prompt, jnp.int32), last_logits),
+        rngs,
+    )
+    return jnp.concatenate([prompt, tokens.T], axis=1)
+
+
+def generate_tp(
+    mesh,
+    config: TransformerConfig,
+    params,
+    prompt: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+) -> jax.Array:
+    """Tensor-parallel generation: the decode step's matmuls and the KV
+    cache shard over ``config.model_axis`` (qkv/proj by head, MLP by
+    hidden dim — the cache inherits the local head count because the
+    Attention module builds it from the sharded K/V it computes).
+
+    ``params`` may be replicated or already placed by
+    ``TRANSFORMER_TP_RULES``; either way the in_specs pin the Megatron
+    layout and the output tokens come back replicated. Exact parity with
+    replicated ``generate`` (tests/test_generate.py) — sampling happens on
+    replicated logits with the same keys.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributed_tpu.parallel.mesh import shard_map
+    from pytorch_distributed_tpu.parallel.tensor import match_partition_rules
+    from pytorch_distributed_tpu.train.lm import TRANSFORMER_TP_RULES
+
+    if config.model_axis is None or config.tp_size <= 1:
+        raise ValueError(
+            "generate_tp needs a TP config (model_axis + tp_size > 1); "
+            "use generate() for replicated decoding"
+        )
+    if mesh.shape[config.model_axis] != config.tp_size:
+        raise ValueError(
+            f"mesh {config.model_axis!r} size "
+            f"{mesh.shape[config.model_axis]} != tp_size {config.tp_size}"
+        )
+    if getattr(config, "attention", "dense") != "dense":
+        raise ValueError("generate_tp is dense-attention only (KV cache)")
+    from pytorch_distributed_tpu.parallel.mesh import MODEL_AXIS
+
+    rules = [
+        (pat, P(*(config.model_axis if part == MODEL_AXIS else part
+                  for part in spec)))
+        for pat, spec in TRANSFORMER_TP_RULES
+    ]
+    param_specs = match_partition_rules(rules, params)
+
+    def local(params, prompt, rng):
+        return _generate_core(config, params, prompt, rng, max_new_tokens,
+                              temperature, top_k)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)(params, prompt, rng)
+
+
 @partial(
     jax.jit,
     static_argnames=("config", "max_new_tokens", "temperature", "top_k"),
@@ -102,43 +206,12 @@ def generate(
         )
     if config.model_axis is not None:
         raise ValueError(
-            "generate() runs replicated — clear model_axis/tp_size on the "
-            "decode config (checkpoints are interchangeable across tp "
+            "generate() runs replicated; for tensor-parallel decoding use "
+            "generate_tp(mesh, config, params, ...) — or clear "
+            "model_axis/tp_size (checkpoints are interchangeable across tp "
             "degrees, so TP-trained params load into the replicated config)"
         )
 
-    # Prefill: one batched causal forward writes the whole prompt's K/V
-    # into the (freshly initialized) cache and yields the last logits.
-    logits, variables = model.apply(
-        {"params": params},
-        prompt,
-        position_offset=0,
-        prefill=True,
-        mutable=["cache"],
-    )
-    cache = variables["cache"]
-    last_logits = logits[:, -1]
-
-    def step(cache, token, pos):
-        logits, variables = model.apply(
-            {"params": params, "cache": cache},
-            token[:, None],
-            position_offset=pos,
-            decode=True,
-            mutable=["cache"],
-        )
-        return variables["cache"], logits[:, 0]
-
-    def decode_body(carry, rng_step):
-        cache, pos, logits = carry
-        token = _sample(logits, rng_step, temperature, top_k)
-        cache, next_logits = step(cache, token, pos)
-        return (cache, pos + 1, next_logits), token
-
-    rngs = jax.random.split(rng, max_new_tokens)
-    _, tokens = jax.lax.scan(
-        decode_body,
-        (cache, jnp.asarray(l_prompt, jnp.int32), last_logits),
-        rngs,
-    )
-    return jnp.concatenate([prompt, tokens.T], axis=1)
+    # Prefill (one batched causal forward filling the cache) + scan decode
+    return _generate_core(config, params, prompt, rng, max_new_tokens,
+                          temperature, top_k)
