@@ -4,6 +4,11 @@ Multi-device behavior (pjit sharding, psum reductions, sampler shard logic)
 is exercised without TPUs via XLA's host-platform device-count override —
 the strategy SURVEY.md §4 prescribes. Must run before jax initializes a
 backend, hence module-level in conftest.
+
+Tiers (the full suite takes >10 min on one contended core):
+  fast   pytest -m "not slow and not multihost"   (~5 min, 124 tests)
+  full   pytest -m "not multihost"                 (everything local)
+  all    pytest                                    (+ real 2-process runs)
 """
 
 import os

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from pytorch_distributed_tpu.ops.attention import (
     blockwise_attention,
     dense_attention,

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from pytorch_distributed_tpu.models.resnet import (
     BottleneckBlock,
     FusedBottleneckBlock,

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from pytorch_distributed_tpu.models.transformer import TransformerLM, tiny_config
 from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
 from pytorch_distributed_tpu.parallel import make_mesh, replicated_sharding

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from pytorch_distributed_tpu.data.tokens import SyntheticTokens, TokenArrayDataset
 from pytorch_distributed_tpu.models.transformer import TransformerLM, tiny_config
 from pytorch_distributed_tpu.parallel import make_mesh
