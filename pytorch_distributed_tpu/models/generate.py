@@ -60,6 +60,32 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
+def _validate_generate_args(config, prompt, max_new_tokens, temperature,
+                            top_k):
+    l_prompt = prompt.shape[1]
+    if l_prompt < 1:
+        raise ValueError("prompt must contain at least one token")
+    if l_prompt + max_new_tokens > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({l_prompt}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_seq_len {config.max_seq_len}"
+        )
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and not 1 <= top_k <= config.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={config.vocab_size}], "
+            f"got {top_k}"
+        )
+    if getattr(config, "attention", "dense") != "dense":
+        raise ValueError(
+            "generation is dense-attention only (the KV cache IS the "
+            "global sequence); build the decode config with "
+            "attention='dense' — ring/ring_flash are training-time "
+            "sequence-parallel layouts"
+        )
+
+
 def _generate_core(config, params, prompt, rng, max_new_tokens, temperature,
                    top_k):
     """The prefill + scan decode body; runs replicated or (under shard_map
@@ -138,29 +164,48 @@ def generate_tp(
             f"mesh {config.model_axis!r} size "
             f"{mesh.shape[config.model_axis]} != tp_size {config.tp_size}"
         )
-    if getattr(config, "attention", "dense") != "dense":
-        raise ValueError("generate_tp is dense-attention only (KV cache)")
-    from pytorch_distributed_tpu.parallel.mesh import MODEL_AXIS
+    _validate_generate_args(config, prompt, max_new_tokens, temperature,
+                            top_k)
+    fn = _generate_tp_compiled(mesh, config, max_new_tokens, temperature,
+                               top_k)
+    return fn(params, prompt, rng)
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=32)
+def _generate_tp_compiled(mesh, config, max_new_tokens, temperature, top_k):
+    """Cached shard_map+jit program per (mesh, config, decode params) —
+    rebuilding the closure per call would recompile every time."""
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributed_tpu.parallel.mesh import MODEL_AXIS, shard_map
+    from pytorch_distributed_tpu.parallel.tensor import match_partition_rules
+    from pytorch_distributed_tpu.train.lm import TRANSFORMER_TP_RULES
 
     rules = [
         (pat, P(*(config.model_axis if part == MODEL_AXIS else part
                   for part in spec)))
         for pat, spec in TRANSFORMER_TP_RULES
     ]
-    param_specs = match_partition_rules(rules, params)
 
     def local(params, prompt, rng):
         return _generate_core(config, params, prompt, rng, max_new_tokens,
                               temperature, top_k)
 
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(param_specs, P(), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return jax.jit(fn)(params, prompt, rng)
+    def build(params, prompt, rng):
+        param_specs = match_partition_rules(rules, params)
+        fn = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(param_specs, P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return fn(params, prompt, rng)
+
+    return jax.jit(build)
 
 
 @partial(
@@ -181,29 +226,8 @@ def generate(
     Returns ``[B, L_prompt + max_new_tokens]``. ``temperature=0`` is
     greedy; ``top_k`` restricts sampling to the k highest logits.
     """
-    model = TransformerLM(config)
-    b, l_prompt = prompt.shape
-    if l_prompt < 1:
-        raise ValueError("prompt must contain at least one token")
-    if l_prompt + max_new_tokens > config.max_seq_len:
-        raise ValueError(
-            f"prompt ({l_prompt}) + max_new_tokens ({max_new_tokens}) "
-            f"exceeds max_seq_len {config.max_seq_len}"
-        )
-    if temperature < 0.0:
-        raise ValueError(f"temperature must be >= 0, got {temperature}")
-    if top_k is not None and not 1 <= top_k <= config.vocab_size:
-        raise ValueError(
-            f"top_k must be in [1, vocab_size={config.vocab_size}], "
-            f"got {top_k}"
-        )
-    if getattr(config, "attention", "dense") in ("ring", "ring_flash"):
-        raise ValueError(
-            "generate() is dense-attention only (the KV cache IS the "
-            "global sequence); build the decode config with "
-            "attention='dense' — ring/ring_flash are training-time "
-            "sequence-parallel layouts"
-        )
+    _validate_generate_args(config, prompt, max_new_tokens, temperature,
+                            top_k)
     if config.model_axis is not None:
         raise ValueError(
             "generate() runs replicated; for tensor-parallel decoding use "

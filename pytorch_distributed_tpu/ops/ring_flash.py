@@ -54,6 +54,24 @@ from pytorch_distributed_tpu.ops.flash_attention import (
 from pytorch_distributed_tpu.parallel.mesh import SEQ_AXIS
 
 
+def _fit_block(requested: int, length: int) -> int:
+    """Largest block <= requested that divides ``length`` (the ring path
+    has no padding, so blocks must divide the shard exactly). Prefers
+    128-multiples (lane alignment); falls back to any divisor, then to the
+    shard itself — raising the tuned defaults must never make a
+    previously-valid call fail."""
+    cap = min(requested, length)
+    if length % cap == 0:
+        return cap
+    for c in range(cap - cap % 128, 0, -128):
+        if length % c == 0:
+            return c
+    for c in range(cap, 0, -1):
+        if length % c == 0:
+            return c
+    return length
+
+
 def _shard_fwd(q3, k3, v3, scale, causal_block, block_q, block_k, interpret):
     """Flash forward on one visiting shard → (o3, lse [BH, L, 1])."""
     o3, lse3 = _flash_fwd(
@@ -480,21 +498,11 @@ def ring_flash_attention(
         if lq % 2:
             raise ValueError(f"zigzag needs an even shard length, got {lq}")
         c = lq // 2
-        block_q = min(block_q, c)
-        block_k = min(block_k, c)
-        if c % block_q or c % block_k:
-            raise ValueError(
-                f"zigzag chunk length {c} must be a multiple of the block "
-                f"sizes ({block_q}, {block_k})"
-            )
+        block_q = _fit_block(block_q, c)
+        block_k = _fit_block(block_k, c)
         return _ring_flash(q, k, v, axis, True, scale, block_q, block_k,
                            interpret, "zigzag")
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
-    if lq % block_q or lk % block_k:
-        raise ValueError(
-            f"shard length {lq} must be a multiple of the block sizes "
-            f"({block_q}, {block_k}); pad the sequence or use ring_attention"
-        )
+    block_q = _fit_block(block_q, lq)
+    block_k = _fit_block(block_k, lk)
     return _ring_flash(q, k, v, axis, causal, scale, block_q, block_k,
                        interpret, "contiguous")

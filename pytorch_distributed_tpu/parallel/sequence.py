@@ -62,11 +62,11 @@ def zigzag_shard(x, s: int, axis: int = 1):
     if l % (2 * s):
         raise ValueError(f"length {l} not divisible by 2*{s} chunks")
     order = np.concatenate([[r, 2 * s - 1 - r] for r in range(s)])
-    parts = jnp.split(x, 2 * s, axis=axis) if hasattr(x, "dtype") else None
-    if parts is None:
-        parts = np.split(x, 2 * s, axis=axis)
-        return np.concatenate([parts[i] for i in order], axis=axis)
-    return jnp.concatenate([parts[i] for i in order], axis=axis)
+    # numpy stays numpy (host pipelines mutate in place; a silent device
+    # round-trip here would also break them) — dispatch on jax.Array
+    xp = jnp if isinstance(x, jax.Array) else np
+    parts = xp.split(x, 2 * s, axis=axis)
+    return xp.concatenate([parts[i] for i in order], axis=axis)
 
 
 def zigzag_unshard(x, s: int, axis: int = 1):
@@ -75,10 +75,9 @@ def zigzag_unshard(x, s: int, axis: int = 1):
 
     order = np.concatenate([[r, 2 * s - 1 - r] for r in range(s)])
     inv = np.argsort(order)
-    cat = jnp.concatenate if hasattr(x, "dtype") else np.concatenate
-    split = jnp.split if hasattr(x, "dtype") else np.split
-    parts = split(x, 2 * s, axis=axis)
-    return cat([parts[i] for i in inv], axis=axis)
+    xp = jnp if isinstance(x, jax.Array) else np
+    parts = xp.split(x, 2 * s, axis=axis)
+    return xp.concatenate([parts[i] for i in inv], axis=axis)
 
 
 def ring_attention(

@@ -182,9 +182,29 @@ def save_sharded(dirpath: str | os.PathLike, payload: Any) -> None:
 
     dirpath = os.fspath(dirpath)
     if os.path.isfile(dirpath):
-        os.remove(dirpath)  # a legacy single-file checkpoint of the same name
+        try:  # a legacy single-file checkpoint of the same name; every
+            os.remove(dirpath)  # process races on a shared fs — one wins
+        except FileNotFoundError:
+            pass
     os.makedirs(dirpath, exist_ok=True)
     pidx = jax.process_index()
+
+    # Save token: guards against TORN saves. A crash mid-save can leave a
+    # directory mixing this save's shard files with a previous save's (the
+    # per-file tmp+rename is atomic per FILE, not per checkpoint). Every
+    # shard embeds the token; the manifest — written LAST, after a barrier
+    # on the data files — records it; load refuses a mismatch. The token
+    # is agreed via broadcast so it needs no shared clock.
+    token = os.urandom(8).hex()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        token_arr = np.frombuffer(bytes.fromhex(token), np.uint8)
+        token = bytes(
+            np.asarray(
+                multihost_utils.broadcast_one_to_all(token_arr)
+            ).tobytes()
+        ).hex()
     paths, leaves, _ = _tree_paths(payload)
 
     my_blocks: dict[str, np.ndarray] = {}
@@ -241,6 +261,7 @@ def save_sharded(dirpath: str | os.PathLike, payload: Any) -> None:
             "blocks": blocks,
         }
 
+    manifest["token"] = token
     # raw byte views (bf16 etc. have no numpy descr; the manifest carries
     # the true dtype) — np.savez streams each buffer straight to disk
     fname = os.path.join(dirpath, f"shard-{pidx:05d}.npz")
@@ -248,6 +269,7 @@ def save_sharded(dirpath: str | os.PathLike, payload: Any) -> None:
     with open(tmp, "wb") as f:
         np.savez(
             f,
+            __token__=np.frombuffer(bytes.fromhex(token), np.uint8),
             **{
                 k: np.ascontiguousarray(v).reshape(-1).view(np.uint8)
                 for k, v in my_blocks.items()
@@ -256,6 +278,12 @@ def save_sharded(dirpath: str | os.PathLike, payload: Any) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, fname)
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # all data files on disk BEFORE the manifest makes the save valid
+        multihost_utils.sync_global_devices(f"ckpt-data:{dirpath}")
 
     if pidx == 0:
         mtmp = os.path.join(dirpath, f"{MANIFEST}.tmp.{os.getpid()}")
@@ -293,13 +321,22 @@ def load_sharded(
 
     shard_cache: dict[str, dict] = {}
 
+    token = manifest.get("token")
+
     def _file(fname):
         if fname not in shard_cache:
             # NpzFile is lazy: only the members a process actually needs
             # are read and decompressed (store is uncompressed anyway)
-            shard_cache[fname] = np.load(
-                os.path.join(dirpath, fname), allow_pickle=False
-            )
+            npz = np.load(os.path.join(dirpath, fname), allow_pickle=False)
+            if token is not None:
+                got = bytes(np.asarray(npz["__token__"]).tobytes()).hex()
+                if got != token:
+                    raise RuntimeError(
+                        f"torn checkpoint at {dirpath}: {fname} belongs to "
+                        f"save {got}, manifest says {token} — a crash "
+                        "interrupted a save; restore an older checkpoint"
+                    )
+            shard_cache[fname] = npz
         return shard_cache[fname]
 
     def _read_region(meta, start, stop):
@@ -393,10 +430,16 @@ class Checkpointer:
         return self._path(BEST)
 
     def has_latest(self) -> bool:
+        if os.path.isdir(self.latest_path):
+            return self.latest_is_sharded()
         return os.path.exists(self.latest_path)
 
     def latest_is_sharded(self) -> bool:
-        return os.path.isdir(self.latest_path)
+        # a dir without a manifest is a save that died before completion —
+        # not a restorable checkpoint
+        return os.path.isdir(self.latest_path) and os.path.exists(
+            os.path.join(self.latest_path, MANIFEST)
+        )
 
     def save_latest_sharded(self, payload: Any) -> None:
         """Per-process sharded save of latest (call on ALL processes; see

@@ -135,3 +135,29 @@ def test_checkpointer_sharded_replaces_legacy_file(devices8, tmp_path):
         np.asarray(back["state"]["w_tp"]),
         np.asarray(payload["state"]["w_tp"]),
     )
+
+
+def test_torn_save_detected(devices8, tmp_path):
+    """A shard file left over from a different save (crash mid-save) must
+    refuse to load, not silently mix two training states."""
+    mesh = make_mesh(devices8, data_parallel=4, model_parallel=2)
+    payload = payload_on_mesh(mesh)
+    d = os.fspath(tmp_path / "ck")
+    save_sharded(d, payload)
+    import shutil
+
+    stale = os.path.join(tmp_path, "stale.npz")
+    shutil.copy(os.path.join(d, "shard-00000.npz"), stale)
+    save_sharded(d, payload)  # a NEWER save (new token)
+    shutil.copy(stale, os.path.join(d, "shard-00000.npz"))  # torn mix
+    with pytest.raises(RuntimeError, match="torn checkpoint"):
+        load_sharded(d, payload)
+
+
+def test_incomplete_save_dir_is_not_latest(devices8, tmp_path):
+    """A directory without a manifest (save died before completion) must
+    not count as a restorable latest checkpoint."""
+    ck = Checkpointer(os.fspath(tmp_path))
+    os.makedirs(ck.latest_path)
+    assert not ck.has_latest()
+    assert not ck.latest_is_sharded()

@@ -85,10 +85,17 @@ def test_ring_flash_single_shard(devices8):
 
 
 def test_ring_flash_validations():
-    q, k, v = qkv(l=30)
-    with pytest.raises(ValueError, match="multiple"):
-        ring_flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    from pytorch_distributed_tpu.ops.ring_flash import _fit_block
+
+    # Irregular shard lengths now ADAPT the block to the largest divisor
+    # (raising the tuned defaults must never break a previously-valid
+    # call) instead of raising; unequal q/kv lengths still error.
+    assert _fit_block(512, 768) == 384  # largest 128-multiple divisor
+    assert _fit_block(16, 30) == 15  # any divisor when no 128-multiple
+    assert _fit_block(1024, 1024) == 1024
+    assert _fit_block(512, 509) == 509  # prime: single block
     q2, _, _ = qkv(l=32)
+    _, k, v = qkv(l=30)
     with pytest.raises(ValueError, match="equal"):
         ring_flash_attention(q2, k, v, interpret=True)
 
