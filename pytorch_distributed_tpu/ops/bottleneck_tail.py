@@ -34,7 +34,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
+    # Mosaic needs the interpreter on ANY non-TPU backend, not just CPU
+    return jax.default_backend() != "tpu"
 
 
 def _row(ref):

@@ -411,9 +411,11 @@ def flash_attention(
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if interpret is None:
-        # Mosaic kernels need the Pallas interpreter off-TPU; auto-detect
-        # so CPU tests/dryruns run the same call sites unmodified.
-        interpret = jax.default_backend() == "cpu"
+        # Mosaic kernels need the Pallas interpreter on ANY non-TPU
+        # backend (a GPU backend would otherwise dispatch Mosaic natively
+        # and fail to compile); auto-detect so CPU tests/dryruns run the
+        # same call sites unmodified.
+        interpret = jax.default_backend() != "tpu"
     lq, lk = q.shape[1], k.shape[1]
     block_q = min(block_q, max(lq, 1))
     block_k = min(block_k, max(lk, 1))

@@ -481,7 +481,7 @@ def ring_flash_attention(
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"  # see flash_attention
+        interpret = jax.default_backend() != "tpu"  # see flash_attention
     lq, lk = q.shape[1], k.shape[1]
     if lq != lk:
         raise ValueError(

@@ -46,7 +46,9 @@ def gpipe(
         slice of transformer blocks). ``mb_idx`` is the index of the
         microbatch this tick computes on THIS stage (clipped during
         warm-up/drain) — derive dropout rngs from it so the pipelined run
-        reproduces the sequential reference's masks exactly.
+        reproduces the sequential reference's masks exactly. A 2-arg
+        ``(stage_params, x)`` stage_fn (the pre-r3 contract) is also
+        accepted and simply doesn't receive the index.
       stage_params: THIS stage's parameters (the local shard of a
         stage-stacked tree).
       microbatches: ``[M, ...]`` — the full input, identical on every stage
@@ -61,6 +63,32 @@ def gpipe(
     in the ring; ``aux`` is valid on EVERY stage for its own real ticks) —
     select stage S-1's output copy via ``last_stage_value`` or a psum-mask.
     """
+    # r2→r3 API compatibility: stage_fns written against the 2-arg contract
+    # ``(stage_params, x)`` (before mb_idx existed for dropout parity) are
+    # accepted and simply don't receive the index. Detected once at trace
+    # time from the signature; *args/**kwargs signatures get the new
+    # 3-arg call.
+    import inspect
+
+    try:
+        params = list(inspect.signature(stage_fn).parameters.values())
+        pos = [p for p in params
+               if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+        if any(p.kind == p.VAR_POSITIONAL for p in params):
+            takes_mb_idx = True
+        elif len(pos) < 3:
+            takes_mb_idx = False
+        elif pos[2].default is inspect.Parameter.empty:
+            takes_mb_idx = True
+        else:
+            # A defaulted third positional is ambiguous: a pre-r3 fn like
+            # ``(params, x, train=False)`` must NOT receive the traced
+            # index in ``train``. Only a parameter literally named mb_idx
+            # opts in.
+            takes_mb_idx = pos[2].name == "mb_idx"
+    except (TypeError, ValueError):  # builtins / C callables
+        takes_mb_idx = True
+
     s = jax.lax.psum(1, axis)
     my = jax.lax.axis_index(axis)
     m = microbatches.shape[0]
@@ -78,12 +106,15 @@ def gpipe(
         feed = microbatches[jnp.clip(t, 0, m - 1)]
         x = jnp.where(my == 0, feed, incoming)
         mb_idx = jnp.clip(t - my, 0, m - 1)
+        call_args = (
+            (stage_params, x, mb_idx) if takes_mb_idx else (stage_params, x)
+        )
         if has_aux:
-            y, aux = stage_fn(stage_params, x, mb_idx)
+            y, aux = stage_fn(*call_args)
             real = ((t >= my) & (t < my + m)).astype(aux.dtype)
             aux_acc = aux_acc + real * aux
         else:
-            y = stage_fn(stage_params, x, mb_idx)
+            y = stage_fn(*call_args)
         # The last stage banks its result at output slot t - (S-1) (valid
         # once the pipeline is full).
         slot = jnp.clip(t - (s - 1), 0, m - 1)

@@ -151,6 +151,10 @@ class LMTrainer(SuspendableTrainer):
         self.eval_step = make_lm_eval_step(
             self.mesh, state_specs=self.state_specs, config=model_config
         )
+        # pre-fault the checkpoint snapshot arena while the first step
+        # compiles — the first non-blocking best-save then stalls only for
+        # its memcpy (see utils.checkpoint._Arena)
+        self.ckpt.warm_for({"state": self.state})
 
         self.best_ppl = float("inf")
         self.start_epoch = 0
@@ -254,6 +258,10 @@ class LMTrainer(SuspendableTrainer):
             self.train_sampler.set_epoch(epoch)
             start_step = self.start_step if epoch == self.start_epoch else 0
             self.train_epoch(epoch, start_step)
+            # commit last epoch's pending best-save: its file write
+            # overlapped this epoch's training; all ranks reach this point
+            # together, so the commit barrier is safely ordered
+            self.ckpt.wait()
             summary = self.validate()
             rank0_print(
                 f"epoch {epoch}: val loss {summary['loss']:.4f} "
@@ -261,11 +269,18 @@ class LMTrainer(SuspendableTrainer):
             )
             if summary["ppl"] < self.best_ppl:
                 self.best_ppl = summary["ppl"]
-                # sharded: all ranks write their blocks, no full gather
-                self.ckpt.save_best_sharded(self._payload_live(epoch + 1, 0))
+                # sharded, non-blocking: only the device→host snapshot runs
+                # here; the file write rides a thread and the commit
+                # (barrier + manifest) lands at the next wait() — a point
+                # every rank reaches in the same order because the psum'd
+                # ppl gives all ranks the same improvement decision
+                self.ckpt.save_best_sharded(
+                    self._payload_live(epoch + 1, 0), block=False
+                )
                 rank0_print(f"new best ppl {self.best_ppl:.3f}, saved best.ckpt")
             self.metrics_log.log(kind="val", epoch=epoch,
                                  epoch_s=time.time() - t0, **summary)
+        self.ckpt.wait()  # commit any pending best-save before returning
         self.start_step = 0
         summary["best_ppl"] = self.best_ppl
         return summary

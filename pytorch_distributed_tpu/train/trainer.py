@@ -170,6 +170,10 @@ class Trainer(SuspendableTrainer):
             state_specs=self.state_specs,
         )
         self.eval_step = make_eval_step(self.mesh, state_specs=self.state_specs)
+        # pre-fault the checkpoint snapshot arena while the first step
+        # compiles — the first non-blocking best-save then stalls only for
+        # its memcpy (see utils.checkpoint._Arena)
+        self.ckpt.warm_for({"state": self.state})
 
         self.best_acc = 0.0
         self.start_epoch = 0
@@ -275,6 +279,10 @@ class Trainer(SuspendableTrainer):
             with trace(enabled=bool(os.environ.get("PDT_TRACE_DIR"))
                        and epoch == self.start_epoch):
                 self.train_epoch(epoch, start_step)
+            # commit last epoch's pending best-save: its file write
+            # overlapped this epoch's training; all ranks reach this point
+            # together, so the commit barrier is safely ordered
+            self.ckpt.wait()
             summary = self.validate()
             rank0_print(
                 f"epoch {epoch}: val loss {summary['loss']:.4f} "
@@ -282,8 +290,14 @@ class Trainer(SuspendableTrainer):
             )
             if summary["acc1"] > self.best_acc:
                 self.best_acc = summary["acc1"]
-                # sharded: all ranks write their blocks, no full gather
-                self.ckpt.save_best_sharded(self._payload_live(epoch + 1, 0))
+                # sharded, non-blocking: only the device→host snapshot runs
+                # here; the file write rides a thread and the commit
+                # (barrier + manifest) lands at the next wait() — a point
+                # every rank reaches in the same order because the psum'd
+                # acc gives all ranks the same improvement decision
+                self.ckpt.save_best_sharded(
+                    self._payload_live(epoch + 1, 0), block=False
+                )
                 rank0_print(f"new best acc1 {self.best_acc:.2f}, saved best.ckpt")
             epoch_s = time.time() - t0
             rank0_print(
@@ -292,6 +306,7 @@ class Trainer(SuspendableTrainer):
             self.metrics_log.log(
                 kind="val", epoch=epoch, epoch_s=epoch_s, **summary
             )
+        self.ckpt.wait()  # commit any pending best-save before returning
         self.start_step = 0
         summary["best_acc"] = self.best_acc
         return summary

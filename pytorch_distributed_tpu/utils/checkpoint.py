@@ -71,6 +71,18 @@ def _needs_gather(x) -> bool:
     )
 
 
+def _owned_host_copy(x) -> np.ndarray:
+    """Host numpy array that OWNS its memory. On TPU ``device_get``
+    already copies; on the CPU backend ``np.asarray(jax_array)`` returns a
+    zero-copy VIEW of the live buffer — which the next donated train step
+    would reuse under a background writer's feet. Copy whenever numpy
+    doesn't own the data."""
+    arr = np.asarray(x)
+    if not arr.flags["OWNDATA"] and not isinstance(x, np.ndarray):
+        arr = np.array(arr)
+    return arr
+
+
 def _to_host(tree: Any) -> Any:
     """Host-side snapshot for serialization. NOT a collective: leaves must
     be locally readable (pass trees through ``gather_global`` first in
@@ -86,7 +98,7 @@ def _to_host(tree: Any) -> Any:
                 "utils.checkpoint.gather_global(tree) before the rank-0 "
                 "save call (process_allgather is a collective)."
             )
-        return np.asarray(jax.device_get(x))
+        return _owned_host_copy(x)
 
     return jax.tree.map(leaf_to_host, tree)
 
@@ -118,6 +130,15 @@ def load_checkpoint(path: str | os.PathLike, template: Any) -> Any:
 
 MANIFEST = "manifest.json"
 
+# shard-<token>-NNNNN.npz (current) or shard-NNNNN.npz (pre-r4 legacy)
+_SHARD_RE = __import__("re").compile(
+    r"^shard-(?:([0-9a-f]+)-)?(\d{5})\.npz$"
+)
+
+
+def _shard_name(token: str, pidx: int) -> str:
+    return f"shard-{token}-{pidx:05d}.npz"
+
 
 def _tree_paths(tree):
     import jax.tree_util as jtu
@@ -135,6 +156,21 @@ def _tree_paths(tree):
             parts.append(str(name))
         paths.append("/".join(parts))
     return paths, [leaf for _, leaf in flat], treedef
+
+
+def _check_unique_paths(paths, where: str) -> None:
+    """Two distinct leaves flattening to one path string (a dict key
+    containing '/', or an int key colliding with a name) would silently
+    share one manifest entry and corrupt the second leaf on restore."""
+    if len(set(paths)) != len(paths):
+        from collections import Counter
+
+        dups = sorted(p for p, c in Counter(paths).items() if c > 1)
+        raise ValueError(
+            f"{where}: pytree flattens to duplicate leaf paths {dups!r} "
+            "(a '/' inside a dict key collides with the path separator); "
+            "rename the offending keys"
+        )
 
 
 def _canonical_blocks(x: jax.Array):
@@ -162,141 +198,370 @@ def _canonical_blocks(x: jax.Array):
     return owners  # {((start, stop), ...): owner_device}
 
 
+class _Arena:
+    """Reusable host snapshot buffer for sharded saves.
+
+    The snapshot must COPY every local block (the live buffers are donated
+    into the next train step), and on this kernel first-touch page faults
+    dominate that copy: 377 separate leaf allocations held live measured
+    12.4 s for a 1.5 GB state, vs 0.65 s for the same copies into reused
+    pages (4 KB write-faults run ~100 MB/s here once the process maps
+    jax's heap; MAP_POPULATE makes it WORSE — it pre-faults the private
+    mapping read-only against the zero page and every write still CoW
+    faults). One arena with ``MADV_HUGEPAGE`` (THP is in madvise mode)
+    faults at 2 MB granularity — measured ~1 s/1.5 GB first fill — and
+    the ``Checkpointer`` reuses it across saves, so steady-state
+    best-save stalls are pure memcpy (~0.3 s/1.5 GB)."""
+
+    def __init__(self):
+        self._mm = None
+        self._size = 0
+
+    def ensure(self, nbytes: int) -> np.ndarray:
+        if nbytes > self._size or self._mm is None:
+            import mmap
+
+            self._mm = mmap.mmap(
+                -1, max(nbytes, 1),
+                flags=mmap.MAP_PRIVATE | mmap.MAP_ANONYMOUS,
+            )
+            if hasattr(self._mm, "madvise") and hasattr(mmap, "MADV_HUGEPAGE"):
+                self._mm.madvise(mmap.MADV_HUGEPAGE)
+            self._size = max(nbytes, 1)
+        return np.frombuffer(self._mm, np.uint8, count=self._size)
+
+    def warm(self, nbytes: int) -> None:
+        """Pre-fault ``nbytes`` of arena by dirtying every page. The fault
+        cost is unavoidable ONCE per arena growth (~10 s/1.5 GB on this
+        kernel even with THP — compaction stalls); trainers run this on a
+        background thread at init, overlapped with the first XLA compile,
+        so even the FIRST non-blocking save stalls only for the memcpy."""
+        buf = self.ensure(nbytes)
+        buf[0::4096] = 1  # one write per 4 KB page
+
+
+class _ShardedSave:
+    """One in-flight sharded save, split into three stages so the step
+    loop only pays for the first:
+
+    1. ``__init__`` — SNAPSHOT (synchronous, collective): broadcast-agree
+       the save token, compute the block layout + manifest from sharding
+       metadata, and ``device_get`` this process's blocks to host numpy.
+       This must happen before the trainer's next step because the state
+       arrays are donated into it.
+    2. ``write`` — pure file I/O (token-named shard file, tmp+rename);
+       safe on a background thread. A save NEVER overwrites the previous
+       checkpoint's data files: they are named by the OLD token and stay
+       referenced by the OLD manifest until step 3 replaces it — a crash
+       any time before then leaves the previous checkpoint fully
+       restorable (the durability fix over the r3 in-place layout).
+    3. ``finalize`` — MAIN THREAD ONLY (cross-host barriers are jax
+       collectives): barrier on the data files, rank-0 atomic manifest
+       replace (the commit point), barrier, then GC this process's
+       stale-token shard files.
+
+    ``save_sharded`` runs all three synchronously;
+    ``Checkpointer.save_*_sharded(block=False)`` runs 2 on a thread and
+    defers 3 to ``Checkpointer.wait()`` — which every rank reaches at the
+    same collective-ordered point (epoch end / suspend / next save).
+    """
+
+    def __init__(self, dirpath: str | os.PathLike, payload: Any,
+                 arena: Optional[_Arena] = None):
+        self.dirpath = os.fspath(dirpath)
+        if os.path.isfile(self.dirpath):
+            try:  # a legacy single-file checkpoint of the same name; every
+                os.remove(self.dirpath)  # process races on shared fs — one wins
+            except FileNotFoundError:
+                pass
+        os.makedirs(self.dirpath, exist_ok=True)
+        self.pidx = jax.process_index()
+
+        # Save token: names this save's files and guards against TORN
+        # saves (manifest written LAST records it; load refuses any
+        # manifest-referenced file carrying a different token). Agreed via
+        # broadcast so it needs no shared clock.
+        token = os.urandom(8).hex()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            token_arr = np.frombuffer(bytes.fromhex(token), np.uint8)
+            token = bytes(
+                np.asarray(
+                    multihost_utils.broadcast_one_to_all(token_arr)
+                ).tobytes()
+            ).hex()
+        self.token = token
+        self.fname = _shard_name(token, self.pidx)
+
+        paths, leaves, _ = _tree_paths(payload)
+        _check_unique_paths(paths, "save_sharded")
+
+        # Pass 1 — metadata only: block layout + manifest + the list of
+        # local blocks to snapshot (no copies yet).
+        specs: list = []  # (key, src, shape, np.dtype)
+        manifest: dict[str, Any] = {"version": 2,
+                                    "n_processes": jax.process_count(),
+                                    "leaves": {}}
+        for path, leaf in zip(paths, leaves):
+            # Block-decompose every non-replicated array (not just the
+            # cross-process ones): the single-process save then exercises
+            # the same layout/assembly path the pod uses, and blocks never
+            # exceed one device's shard.
+            if (
+                isinstance(leaf, jax.Array)
+                and leaf.ndim > 0
+                and not leaf.is_fully_replicated
+            ):
+                layout = _canonical_blocks(leaf)
+                local = {
+                    tuple(
+                        (sl.start or 0,
+                         sl.stop if sl.stop is not None else dim)
+                        for sl, dim in zip(sh.index, leaf.shape)
+                    ): sh
+                    for sh in leaf.addressable_shards
+                }
+                blocks = []
+                for i, (key, dev) in enumerate(sorted(layout.items())):
+                    entry = {
+                        "file": _shard_name(token, dev.process_index),
+                        "key": f"{path}#{i}",
+                        "start": [s for s, _ in key],
+                        "stop": [e for _, e in key],
+                    }
+                    blocks.append(entry)
+                    if dev.process_index == self.pidx:
+                        specs.append((
+                            entry["key"], local[key].data,
+                            tuple(e - s for s, e in key),
+                            np.dtype(leaf.dtype),
+                        ))
+                arr_like = leaf
+            else:
+                arr_like = (
+                    leaf if isinstance(leaf, jax.Array) else np.asarray(leaf)
+                )
+                blocks = [{
+                    "file": _shard_name(token, 0),
+                    "key": f"{path}#0",
+                    "start": [0] * arr_like.ndim,
+                    "stop": list(arr_like.shape),
+                }]
+                if self.pidx == 0:
+                    specs.append((
+                        f"{path}#0", arr_like, tuple(arr_like.shape),
+                        np.dtype(arr_like.dtype),
+                    ))
+            manifest["leaves"][path] = {
+                "dtype": str(np.dtype(arr_like.dtype)),
+                "shape": list(arr_like.shape),
+                "blocks": blocks,
+            }
+        manifest["token"] = token
+        self.manifest = manifest
+
+        # Pass 2 — SNAPSHOT: one bulk copy of every local block into a
+        # single (reusable) arena. The copy is mandatory — the live
+        # buffers are donated into the next train step, and on the CPU
+        # backend ``np.asarray(jax_array)`` is a zero-copy view of them.
+        # See ``_Arena`` for why one buffer instead of per-leaf copies.
+        total = 0
+        offs = []
+        for _key, _src, shape, dtype in specs:
+            total = -(-total // 128) * 128  # 128-byte align each block
+            offs.append(total)
+            total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        self._arena_buf = (arena or _Arena()).ensure(total)
+        my_blocks: dict[str, np.ndarray] = {}
+        for (key, src, shape, dtype), off in zip(specs, offs):
+            nb = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            dst = self._arena_buf[off:off + nb].view(dtype).reshape(shape)
+            np.copyto(dst, np.asarray(src))
+            my_blocks[key] = dst
+        self.my_blocks = my_blocks
+        self._thread: Optional[threading.Thread] = None
+        self._write_err: Optional[BaseException] = None
+        self._done = False
+
+    def write(self) -> None:
+        """Write this process's token-named shard file. Pure file I/O —
+        thread-safe, no jax calls."""
+        # raw byte views (bf16 etc. have no numpy descr; the manifest
+        # carries the true dtype) — np.savez streams each buffer to disk
+        fname = os.path.join(self.dirpath, self.fname)
+        tmp = f"{fname}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                __token__=np.frombuffer(
+                    bytes.fromhex(self.token), np.uint8
+                ),
+                **{
+                    k: np.ascontiguousarray(v).reshape(-1).view(np.uint8)
+                    for k, v in self.my_blocks.items()
+                },
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fname)
+        self.my_blocks = {}  # release the host snapshot
+
+    def _write_guarded(self) -> None:
+        try:
+            self.write()
+        except BaseException as e:  # surfaced at finalize()
+            self._write_err = e
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._write_guarded,
+                                        daemon=True)
+        self._thread.start()
+
+    def finalize(self) -> None:
+        """Join the writer, barrier, commit the manifest, GC stale files.
+        Call from the MAIN thread on every process at the same
+        collectively-ordered point."""
+        import json
+
+        if self._done:
+            return
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._write_err is not None:
+            raise self._write_err
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            # all data files on disk BEFORE the manifest makes them live
+            multihost_utils.sync_global_devices(
+                f"ckpt-data:{self.dirpath}:{self.token}"
+            )
+
+        if self.pidx == 0:
+            # THE commit point: os.replace is atomic, and the old
+            # manifest's files are untouched until the GC below.
+            mtmp = os.path.join(self.dirpath,
+                                f"{MANIFEST}.tmp.{os.getpid()}")
+            with open(mtmp, "w") as f:
+                json.dump(self.manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, os.path.join(self.dirpath, MANIFEST))
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"ckpt:{self.dirpath}:{self.token}"
+            )
+
+        # GC: every process removes ITS OWN rank's shard files from
+        # superseded saves (older tokens + pre-r4 tokenless names) and any
+        # orphaned tmp files. Only after the commit barrier — a reader
+        # before it was reading the old manifest's files.
+        for name in os.listdir(self.dirpath):
+            m = _SHARD_RE.match(name)
+            stale_shard = (
+                m is not None
+                and int(m.group(2)) == self.pidx
+                and (m.group(1) or "") != self.token
+            )
+            stale_tmp = (
+                f".npz.tmp." in name
+                and f"-{self.pidx:05d}.npz.tmp." in name
+                and not name.startswith(f"shard-{self.token}-")
+            )
+            if stale_shard or stale_tmp:
+                try:
+                    os.remove(os.path.join(self.dirpath, name))
+                except OSError:
+                    pass
+        self._done = True
+
+
 def save_sharded(dirpath: str | os.PathLike, payload: Any) -> None:
     """Per-process sharded checkpoint: NO process materializes the global
     state (the scaling fix for ``gather_global``'s full host gather —
     VERDICT r2 missing #5).
 
-    Layout: ``<dirpath>/shard-NNNNN.npz`` (uncompressed zip of raw block
-    buffers — msgpack measured 8.7x slower than the disk) holds the blocks
-    whose canonical owner device lives on process NNNNN; ``manifest.json``
-    (rank 0) records every leaf's dtype/shape and block table, computed
-    from sharding metadata identically on every process. Replicated
-    leaves, numpy arrays, and scalars are rank-0-owned single blocks.
-    COLLECTIVE in the weak sense: every process must call it (each writes
-    its own file); a cross-host barrier at the end guarantees all files
-    landed before anyone proceeds to yield/exit. Atomic per file
-    (tmp+rename, like ``save_checkpoint``).
+    Layout: ``<dirpath>/shard-<token>-NNNNN.npz`` (uncompressed zip of raw
+    block buffers — msgpack measured 8.7x slower than the disk) holds the
+    blocks whose canonical owner device lives on process NNNNN;
+    ``manifest.json`` (rank 0, written last, atomic replace) records every
+    leaf's dtype/shape and block table, computed from sharding metadata
+    identically on every process. Replicated leaves, numpy arrays, and
+    scalars are rank-0-owned single blocks. COLLECTIVE in the weak sense:
+    every process must call it (each writes its own file); a cross-host
+    barrier before the manifest guarantees all files landed. Atomic at
+    CHECKPOINT granularity: files are token-named, so a crash mid-save
+    leaves the previous save's manifest + files intact and restorable
+    (see ``_ShardedSave``). Synchronous; for the non-stalling trainer
+    path use ``Checkpointer.save_*_sharded(block=False)`` + ``wait()``.
     """
-    import json
+    s = _ShardedSave(dirpath, payload)
+    s.write()
+    s.finalize()
 
-    dirpath = os.fspath(dirpath)
-    if os.path.isfile(dirpath):
-        try:  # a legacy single-file checkpoint of the same name; every
-            os.remove(dirpath)  # process races on a shared fs — one wins
-        except FileNotFoundError:
-            pass
-    os.makedirs(dirpath, exist_ok=True)
-    pidx = jax.process_index()
 
-    # Save token: guards against TORN saves. A crash mid-save can leave a
-    # directory mixing this save's shard files with a previous save's (the
-    # per-file tmp+rename is atomic per FILE, not per checkpoint). Every
-    # shard embeds the token; the manifest — written LAST, after a barrier
-    # on the data files — records it; load refuses a mismatch. The token
-    # is agreed via broadcast so it needs no shared clock.
-    token = os.urandom(8).hex()
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+class _RawNpz:
+    """Zero-copy reader for the uncompressed ``.npz`` files ``np.savez``
+    writes: mmap the zip once, resolve each member's raw-data offset from
+    the local file headers, and serve members as ``np.frombuffer`` views.
+    Skips the per-member stream+CRC pass ``np.load`` does — restore cost
+    becomes the assembly copies / ``device_put`` alone, with cold pages
+    faulted in by the kernel during the copy. Views are READ-ONLY;
+    ``load_sharded`` copies on any path that hands arrays to the caller
+    unsharded. Raises on anything unexpected (compressed members, odd npy
+    headers); the caller falls back to ``np.load``."""
 
-        token_arr = np.frombuffer(bytes.fromhex(token), np.uint8)
-        token = bytes(
-            np.asarray(
-                multihost_utils.broadcast_one_to_all(token_arr)
-            ).tobytes()
-        ).hex()
-    paths, leaves, _ = _tree_paths(payload)
+    def __init__(self, path: str):
+        import mmap
+        import zipfile
 
-    my_blocks: dict[str, np.ndarray] = {}
-    manifest: dict[str, Any] = {"version": 1,
-                                "n_processes": jax.process_count(),
-                                "leaves": {}}
-    for path, leaf in zip(paths, leaves):
-        # Block-decompose every non-replicated array (not just the
-        # cross-process ones): the single-process save then exercises the
-        # same layout/assembly path the pod uses, and blocks never exceed
-        # one device's shard.
-        if (
-            isinstance(leaf, jax.Array)
-            and leaf.ndim > 0
-            and not leaf.is_fully_replicated
-        ):
-            layout = _canonical_blocks(leaf)
-            local = {
-                tuple(
-                    (sl.start or 0,
-                     sl.stop if sl.stop is not None else dim)
-                    for sl, dim in zip(sh.index, leaf.shape)
-                ): sh
-                for sh in leaf.addressable_shards
-            }
-            blocks = []
-            for i, (key, dev) in enumerate(sorted(layout.items())):
-                entry = {
-                    "file": f"shard-{dev.process_index:05d}.npz",
-                    "key": f"{path}#{i}",
-                    "start": [s for s, _ in key],
-                    "stop": [e for _, e in key],
-                }
-                blocks.append(entry)
-                if dev.process_index == pidx:
-                    my_blocks[entry["key"]] = np.asarray(local[key].data)
-            arr_like = leaf
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        self._members: dict[str, tuple[int, int]] = {}
+        with zipfile.ZipFile(self._f) as zf:
+            for info in zf.infolist():
+                if info.compress_type != zipfile.ZIP_STORED:
+                    raise ValueError("compressed member")
+                ho = info.header_offset
+                if self._mm[ho:ho + 4] != b"PK\x03\x04":
+                    raise ValueError("bad local header")
+                # local-header extra field length can differ from the
+                # central directory's — read it from the local header
+                fn = int.from_bytes(self._mm[ho + 26:ho + 28], "little")
+                ex = int.from_bytes(self._mm[ho + 28:ho + 30], "little")
+                name = info.filename
+                if name.endswith(".npy"):
+                    name = name[:-4]
+                self._members[name] = (ho + 30 + fn + ex, info.file_size)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._members
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        import io
+
+        off, size = self._members[key]
+        bio = io.BytesIO(self._mm[off:min(off + 4096, off + size)])
+        version = np.lib.format.read_magic(bio)
+        if version == (1, 0):
+            shape, fortran, dtype = np.lib.format.read_array_header_1_0(bio)
+        elif version == (2, 0):
+            shape, fortran, dtype = np.lib.format.read_array_header_2_0(bio)
         else:
-            arr = np.asarray(
-                jax.device_get(leaf) if isinstance(leaf, jax.Array) else leaf
-            )
-            blocks = [{
-                "file": "shard-00000.npz",
-                "key": f"{path}#0",
-                "start": [0] * arr.ndim,
-                "stop": list(arr.shape),
-            }]
-            if pidx == 0:
-                my_blocks[f"{path}#0"] = arr
-            arr_like = arr
-        manifest["leaves"][path] = {
-            "dtype": str(np.dtype(arr_like.dtype)),
-            "shape": list(arr_like.shape),
-            "blocks": blocks,
-        }
-
-    manifest["token"] = token
-    # raw byte views (bf16 etc. have no numpy descr; the manifest carries
-    # the true dtype) — np.savez streams each buffer straight to disk
-    fname = os.path.join(dirpath, f"shard-{pidx:05d}.npz")
-    tmp = f"{fname}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        np.savez(
-            f,
-            __token__=np.frombuffer(bytes.fromhex(token), np.uint8),
-            **{
-                k: np.ascontiguousarray(v).reshape(-1).view(np.uint8)
-                for k, v in my_blocks.items()
-            },
+            raise ValueError(f"npy version {version}")
+        if fortran:
+            raise ValueError("fortran-order member")
+        count = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(
+            self._mm, dtype=dtype, count=count, offset=off + bio.tell()
         )
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, fname)
-
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        # all data files on disk BEFORE the manifest makes the save valid
-        multihost_utils.sync_global_devices(f"ckpt-data:{dirpath}")
-
-    if pidx == 0:
-        mtmp = os.path.join(dirpath, f"{MANIFEST}.tmp.{os.getpid()}")
-        with open(mtmp, "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(mtmp, os.path.join(dirpath, MANIFEST))
-
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(f"ckpt:{dirpath}")
+        return arr.reshape(shape)
 
 
 def load_sharded(
@@ -309,7 +574,9 @@ def load_sharded(
     ``jax.make_array_from_callback`` reading ONLY the blocks overlapping
     each local device shard — no process assembles a full copy of a
     sharded leaf. Without it, leaves come back as full numpy (the
-    single-process / legacy-compatible path).
+    single-process / legacy-compatible path). Reads go through an
+    mmap-backed zero-copy zip reader (``_RawNpz``) with a per-region
+    cache, so replicated leaves aren't re-read once per device.
     """
     import json
 
@@ -319,15 +586,18 @@ def load_sharded(
     with open(os.path.join(dirpath, MANIFEST)) as f:
         manifest = json.load(f)
 
-    shard_cache: dict[str, dict] = {}
+    shard_cache: dict[str, Any] = {}
 
     token = manifest.get("token")
 
     def _file(fname):
         if fname not in shard_cache:
-            # NpzFile is lazy: only the members a process actually needs
-            # are read and decompressed (store is uncompressed anyway)
-            npz = np.load(os.path.join(dirpath, fname), allow_pickle=False)
+            fpath = os.path.join(dirpath, fname)
+            try:
+                npz = _RawNpz(fpath)
+            except Exception:
+                # NpzFile is lazy: only members actually accessed are read
+                npz = np.load(fpath, allow_pickle=False)
             if token is not None:
                 got = bytes(np.asarray(npz["__token__"]).tobytes()).hex()
                 if got != token:
@@ -376,10 +646,22 @@ def load_sharded(
         return out
 
     paths, t_leaves, treedef = _tree_paths(template)
+    _check_unique_paths(paths, "load_sharded")
     if shardings is None:
         s_leaves = [None] * len(t_leaves)
     else:
         s_paths, s_leaves, _ = _tree_paths(shardings)
+
+    # make_array_from_callback invokes the callback once per addressable
+    # device; replicated / partially-replicated leaves repeat identical
+    # (start, stop) regions — serve those from a cache, not a re-read.
+    region_cache: dict = {}
+
+    def _read_region_cached(path, meta, start, stop):
+        key = (path, tuple(start), tuple(stop))
+        if key not in region_cache:
+            region_cache[key] = _read_region(meta, start, stop)
+        return region_cache[key]
 
     restored = []
     for path, tleaf, sleaf in zip(paths, t_leaves, s_leaves):
@@ -393,8 +675,9 @@ def load_sharded(
         if isinstance(sleaf, jax.sharding.Sharding) and shape:
             arr = jax.make_array_from_callback(
                 shape, sleaf,
-                lambda idx, meta=meta, shape=shape: _read_region(
-                    meta,
+                lambda idx, path=path, meta=meta, shape=shape:
+                _read_region_cached(
+                    path, meta,
                     [sl.start or 0 for sl in idx],
                     [sl.stop if sl.stop is not None else d
                      for sl, d in zip(idx, shape)],
@@ -402,6 +685,11 @@ def load_sharded(
             )
         else:
             arr = _read_region(meta, [0] * len(shape), list(shape))
+            if not arr.flags.writeable:
+                # _RawNpz exact-match views are read-only mmap windows;
+                # arrays handed to the caller unsharded must own their
+                # memory (and not pin the map open)
+                arr = np.array(arr)
         restored.append(arr)
     return jtu.tree_unflatten(treedef, restored)
 
@@ -409,14 +697,23 @@ def load_sharded(
 class Checkpointer:
     """latest/best artifact manager for a save directory.
 
-    ``save_latest`` optionally runs in a background thread (``wait()`` to
-    join — the suspend path does); ``save_best`` is called on metric
-    improvement only, like ``restnet_ddp.py:145-150``.
+    Sharded saves can run non-blocking: ``save_*_sharded(payload,
+    block=False)`` pays only the device→host snapshot on the calling
+    thread, writes the token-named shard file on a background thread, and
+    defers the commit (cross-host barrier + manifest replace + GC) to
+    ``wait()`` — which trainers call at epoch end, on suspend, and before
+    any subsequent save, points every rank reaches in the same collective
+    order. Until ``wait()`` commits, the previous checkpoint stays fully
+    restorable (token-named files are never overwritten). ``save_best``
+    fires on metric improvement only, like ``restnet_ddp.py:145-150``.
     """
 
     def __init__(self, save_dir: str | os.PathLike):
         self.save_dir = os.fspath(save_dir)
         self._thread: Optional[threading.Thread] = None
+        self._pending: Optional[_ShardedSave] = None
+        self._arena = _Arena()  # snapshot pages reused across saves
+        self._warm_thread: Optional[threading.Thread] = None
 
     def _path(self, name: str) -> str:
         return os.path.join(self.save_dir, name)
@@ -428,6 +725,49 @@ class Checkpointer:
     @property
     def best_path(self) -> str:
         return self._path(BEST)
+
+    def warm_for(self, payload: Any) -> None:
+        """Pre-fault the snapshot arena for ``payload``-sized saves on a
+        background thread. Call once at trainer init, after the state is
+        built — the page-fault cost (the dominant cost of a first
+        snapshot) then overlaps the first compile instead of the first
+        best-save. Size is the full local payload footprint — exact for
+        single-process runs, an over-estimate (harmless: virtual memory)
+        for cross-process-sharded states."""
+        def _aligned(nb: int) -> int:
+            return -(-nb // 128) * 128  # mirror _ShardedSave's alignment
+
+        nbytes = 0
+        for leaf in jax.tree.leaves(payload):
+            if (
+                isinstance(leaf, jax.Array)
+                and leaf.ndim > 0
+                and not leaf.is_fully_replicated
+            ):
+                # sharded branch: one block per canonically-owned shard;
+                # addressable shards are an upper bound on ownership
+                itemsize = np.dtype(leaf.dtype).itemsize
+                for s in leaf.addressable_shards:
+                    nbytes += _aligned(
+                        int(np.prod(s.data.shape, dtype=np.int64)) * itemsize
+                    )
+            elif isinstance(leaf, jax.Array):
+                # replicated: snapshotted ONCE as a rank-0 block, never
+                # once per device copy
+                nbytes += _aligned(
+                    int(np.prod(leaf.shape, dtype=np.int64))
+                    * np.dtype(leaf.dtype).itemsize
+                )
+            else:
+                nbytes += _aligned(np.asarray(leaf).nbytes)
+        # the live save payload wraps the state with epoch/step/best
+        # scalars the caller doesn't pass here — leave aligned headroom so
+        # ensure() never discards the pre-faulted map over a few leaves
+        nbytes += 64 * 1024
+        self._warm_thread = threading.Thread(
+            target=self._arena.warm, args=(nbytes,), daemon=True
+        )
+        self._warm_thread.start()
 
     def has_latest(self) -> bool:
         if os.path.isdir(self.latest_path):
@@ -441,17 +781,39 @@ class Checkpointer:
             os.path.join(self.latest_path, MANIFEST)
         )
 
-    def save_latest_sharded(self, payload: Any) -> None:
-        """Per-process sharded save of latest (call on ALL processes; see
-        ``save_sharded``). Synchronous — the suspend path is about to
-        yield, and the cross-host barrier must not run on a thread."""
-        self.wait()
-        save_sharded(self.latest_path, payload)
+    def has_best(self) -> bool:
+        if os.path.isdir(self.best_path):
+            return self.best_is_sharded()
+        return os.path.exists(self.best_path)
 
-    def save_best_sharded(self, payload: Any) -> None:
-        save_sharded(self.best_path, payload)
+    def best_is_sharded(self) -> bool:
+        return os.path.isdir(self.best_path) and os.path.exists(
+            os.path.join(self.best_path, MANIFEST)
+        )
+
+    def _save_sharded(self, path: str, payload: Any, block: bool) -> None:
+        self.wait()  # one in-flight save at a time; commit the previous
+        if block:
+            s = _ShardedSave(path, payload, arena=self._arena)
+            s.write()
+            s.finalize()
+        else:
+            # snapshot only (fast: bulk copy into the reused arena)
+            s = _ShardedSave(path, payload, arena=self._arena)
+            s.start()  # file write on a thread
+            self._pending = s  # commit deferred to wait()
+
+    def save_latest_sharded(self, payload: Any, block: bool = True) -> None:
+        """Per-process sharded save of latest (call on ALL processes; see
+        ``save_sharded``). The suspend path keeps ``block=True`` — it is
+        about to yield, and the commit barrier must run before it does."""
+        self._save_sharded(self.latest_path, payload, block)
+
+    def save_best_sharded(self, payload: Any, block: bool = True) -> None:
+        self._save_sharded(self.best_path, payload, block)
 
     def load_latest_sharded(self, template: Any, shardings: Any = None) -> Any:
+        self.wait()
         return load_sharded(self.latest_path, template, shardings)
 
     def save_latest(self, payload: Any, block: bool = True) -> None:
@@ -469,16 +831,34 @@ class Checkpointer:
         save_checkpoint(self.best_path, payload)
 
     def load_latest(self, template: Any) -> Any:
+        self.wait()
         if self.latest_is_sharded():
             return load_sharded(self.latest_path, template)
         return load_checkpoint(self.latest_path, template)
 
-    def load_best(self, template: Any) -> Any:
+    def load_best(self, template: Any, shardings: Any = None) -> Any:
+        self.wait()
+        if self.best_is_sharded():
+            return load_sharded(self.best_path, template, shardings)
         if os.path.isdir(self.best_path):
-            return load_sharded(self.best_path, template)
+            raise FileNotFoundError(
+                f"{self.best_path} is a directory without a manifest — a "
+                "best-save died before its commit point; no completed best "
+                "checkpoint exists"
+            )
         return load_checkpoint(self.best_path, template)
 
     def wait(self) -> None:
+        """Join any background write and COMMIT any pending sharded save
+        (cross-host barrier + manifest + GC). Collective when a sharded
+        save is pending multi-process — call at the same point on every
+        rank (trainers: epoch end, suspend, before the next save)."""
+        if self._warm_thread is not None:
+            self._warm_thread.join()  # never race a save into the arena
+            self._warm_thread = None
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.finalize()

@@ -84,10 +84,10 @@ def run_lm(save_dir: str) -> None:
         trainer.ckpt.save_latest_sharded(trainer._payload_live(1, 5))
         import glob as _glob
 
-        my_file = os.path.join(
-            save_dir, "latest.ckpt", f"shard-{get_rank():05d}.npz"
-        )
-        assert os.path.exists(my_file), my_file
+        my_files = _glob.glob(os.path.join(
+            save_dir, "latest.ckpt", f"shard-*-{get_rank():05d}.npz"
+        ))
+        assert my_files, f"no shard file for rank {get_rank()}"
         # the TP-sharded qkv stack's blocks span BOTH processes' files
         with open(os.path.join(save_dir, "latest.ckpt",
                                "manifest.json")) as f:
@@ -132,6 +132,53 @@ def run_lm(save_dir: str) -> None:
     }))
 
 
+def _tiny_lm_trainer(save_dir: str):
+    from pytorch_distributed_tpu.data import SyntheticTokens
+    from pytorch_distributed_tpu.models.transformer import tiny_config
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.train import LMTrainer, LMTrainerConfig
+
+    mesh = make_mesh(data_parallel=2, seq_parallel=2, model_parallel=2)
+    model_cfg = tiny_config(
+        attention="ring", model_axis="model", tp_size=2, dropout=0.0
+    )
+    cfg = LMTrainerConfig(epochs=1, batch_size=2, lr=1e-2, save_dir=save_dir,
+                          num_workers=0, log_every=2)
+    train = SyntheticTokens(size=16, seq_len=32, vocab_size=128)
+    val = SyntheticTokens(size=8, seq_len=32, vocab_size=128, seed=9)
+    return LMTrainer(model_cfg, train, val, cfg, mesh=mesh)
+
+
+def run_lm_crash_save(save_dir: str) -> None:
+    """Complete save (epoch 1, step 5), then a save that 'crashes' after
+    its data files land but BEFORE the manifest commit (epoch 2, step 9).
+    The parent relaunches with lm_crash_resume and asserts the survivor is
+    the COMPLETE save — the durability property of token-named files."""
+    from pytorch_distributed_tpu.parallel.distributed import get_rank
+    from pytorch_distributed_tpu.utils.checkpoint import _ShardedSave
+
+    trainer = _tiny_lm_trainer(save_dir)
+    trainer.ckpt.save_latest_sharded(trainer._payload_live(1, 5))
+    crash = _ShardedSave(trainer.ckpt.latest_path,
+                         trainer._payload_live(2, 9))
+    crash.write()  # both ranks' data files land...
+    # ...and the job dies before finalize(): no barrier, no manifest
+    print(json.dumps({"rank": get_rank(), "crash_save_done": True}))
+
+
+def run_lm_crash_resume(save_dir: str) -> None:
+    from pytorch_distributed_tpu.parallel.distributed import get_rank
+
+    trainer = _tiny_lm_trainer(save_dir)
+    resumed = trainer.try_resume()
+    print(json.dumps({
+        "rank": get_rank(),
+        "resumed": bool(resumed),
+        "epoch": int(trainer.start_epoch),
+        "step": int(trainer.start_step),
+    }))
+
+
 def main() -> None:
     mode = sys.argv[1]
     save_dir = sys.argv[2]
@@ -155,6 +202,12 @@ def main() -> None:
 
     if mode == "lm":
         run_lm(save_dir)
+        return
+    if mode == "lm_crash_save":
+        run_lm_crash_save(save_dir)
+        return
+    if mode == "lm_crash_resume":
+        run_lm_crash_resume(save_dir)
         return
 
     model = ResNet(

@@ -4,6 +4,7 @@ full-state gather on any rank. Single-process coverage here; the real
 two-process no-gather guarantee is asserted in tests/test_multihost.py
 (process_allgather patched to raise during save+resume)."""
 
+import glob
 import json
 import os
 
@@ -48,7 +49,8 @@ def test_roundtrip_bit_exact_with_shardings(devices8, tmp_path):
     save_sharded(d, payload)
 
     assert os.path.exists(os.path.join(d, "manifest.json"))
-    assert os.path.exists(os.path.join(d, "shard-00000.npz"))
+    # token-named data file: shard-<token>-00000.npz
+    assert glob.glob(os.path.join(d, "shard-*-00000.npz"))
 
     shardings = jax.tree.map(lambda _: False, payload)
     shardings["state"] = {
@@ -138,20 +140,105 @@ def test_checkpointer_sharded_replaces_legacy_file(devices8, tmp_path):
 
 
 def test_torn_save_detected(devices8, tmp_path):
-    """A shard file left over from a different save (crash mid-save) must
-    refuse to load, not silently mix two training states."""
+    """A manifest-referenced data file carrying a different save's token
+    (filesystem damage / manual copy) must refuse to load, not silently
+    mix two training states."""
     mesh = make_mesh(devices8, data_parallel=4, model_parallel=2)
     payload = payload_on_mesh(mesh)
     d = os.fspath(tmp_path / "ck")
     save_sharded(d, payload)
-    import shutil
-
-    stale = os.path.join(tmp_path, "stale.npz")
-    shutil.copy(os.path.join(d, "shard-00000.npz"), stale)
-    save_sharded(d, payload)  # a NEWER save (new token)
-    shutil.copy(stale, os.path.join(d, "shard-00000.npz"))  # torn mix
+    (f1,) = glob.glob(os.path.join(d, "shard-*-00000.npz"))
+    with open(f1, "rb") as f:
+        stale_bytes = f.read()  # belongs to save 1's token
+    save_sharded(d, payload)  # a NEWER save (new token; GCs save 1's file)
+    (f2,) = glob.glob(os.path.join(d, "shard-*-00000.npz"))
+    with open(f2, "wb") as f:
+        f.write(stale_bytes)  # wrong-token content behind the live name
     with pytest.raises(RuntimeError, match="torn checkpoint"):
         load_sharded(d, payload)
+
+
+def test_crash_mid_save_keeps_previous_checkpoint(devices8, tmp_path):
+    """THE durability property the token-named layout buys (ADVICE r3
+    medium): a save that dies after writing data files but before the
+    manifest commit leaves the PREVIOUS checkpoint fully restorable —
+    token-named files mean the new save never clobbered it."""
+    from pytorch_distributed_tpu.utils.checkpoint import _ShardedSave
+
+    mesh = make_mesh(devices8, data_parallel=4, model_parallel=2)
+    p1 = payload_on_mesh(mesh)
+    d = os.fspath(tmp_path / "ck")
+    save_sharded(d, p1)
+
+    p2 = payload_on_mesh(mesh)
+    p2["state"]["w_tp"] = jax.device_put(
+        jnp.zeros((8, 16), jnp.float32),
+        NamedSharding(mesh, P(None, "model")),
+    )
+    crash = _ShardedSave(d, p2)
+    crash.write()  # data files land...
+    # ...and the process dies before finalize(): no barrier, no manifest
+    back = load_sharded(d, p1)
+    np.testing.assert_array_equal(
+        np.asarray(back["state"]["w_tp"]), np.asarray(p1["state"]["w_tp"])
+    )
+
+
+def test_successful_save_gcs_stale_shard_files(devices8, tmp_path):
+    """A completed save removes superseded saves' data files (including a
+    crashed save's orphans) — directories don't grow one shard file per
+    save forever."""
+    mesh = make_mesh(devices8, data_parallel=4, model_parallel=2)
+    payload = payload_on_mesh(mesh)
+    d = os.fspath(tmp_path / "ck")
+    save_sharded(d, payload)
+    save_sharded(d, payload)
+    files = glob.glob(os.path.join(d, "shard-*.npz"))
+    assert len(files) == 1  # single process: exactly one live shard file
+
+
+def test_async_save_via_checkpointer(devices8, tmp_path):
+    """block=False: snapshot returns immediately, the old best stays
+    loadable until wait() commits, and after wait() the new best loads."""
+    mesh = make_mesh(devices8, data_parallel=4, model_parallel=2)
+    ck = Checkpointer(os.fspath(tmp_path))
+    p1 = payload_on_mesh(mesh)
+    ck.save_best_sharded(p1)  # blocking baseline save
+    p2 = payload_on_mesh(mesh)
+    p2["state"]["w_tp"] = jax.device_put(
+        jnp.full((8, 16), 7.0, jnp.float32),
+        NamedSharding(mesh, P(None, "model")),
+    )
+    ck.save_best_sharded(p2, block=False)
+    # before the commit, the manifest still points at save 1
+    back = load_sharded(ck.best_path, p1)
+    np.testing.assert_array_equal(
+        np.asarray(back["state"]["w_tp"]), np.asarray(p1["state"]["w_tp"])
+    )
+    ck.wait()
+    back = ck.load_best(p2)
+    np.testing.assert_array_equal(
+        np.asarray(back["state"]["w_tp"]), np.asarray(p2["state"]["w_tp"])
+    )
+
+
+def test_load_best_incomplete_dir_raises_cleanly(devices8, tmp_path):
+    """ADVICE r3 low: a best dir without a manifest (crashed save) gets
+    the deliberate error, not a raw manifest.json FileNotFoundError."""
+    ck = Checkpointer(os.fspath(tmp_path))
+    os.makedirs(ck.best_path)
+    assert not ck.has_best()
+    assert not ck.best_is_sharded()
+    with pytest.raises(FileNotFoundError, match="without a manifest"):
+        ck.load_best({"a": np.float32(0.0)})
+
+
+def test_duplicate_leaf_paths_rejected(devices8, tmp_path):
+    """ADVICE r3 low: two leaves flattening to one path string must fail
+    loudly at save time, not corrupt the second leaf at restore."""
+    payload = {"a": {"b": np.float32(1.0)}, "a/b": np.float32(2.0)}
+    with pytest.raises(ValueError, match="duplicate leaf paths"):
+        save_sharded(os.fspath(tmp_path / "ck"), payload)
 
 
 def test_incomplete_save_dir_is_not_latest(devices8, tmp_path):

@@ -89,3 +89,69 @@ def test_gpipe_grads_match_sequential(devices8):
     g_seq = jax.grad(loss_seq)(params, x)
     for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_gpipe_accepts_two_arg_stage_fn(devices8):
+    """ADVICE r3 low: the pre-r3 ``(stage_params, x)`` stage_fn contract
+    still works — the executor detects the arity once at trace time and
+    omits mb_idx."""
+    mesh = make_mesh(devices8, data_parallel=2, model_parallel=4)
+    rng = np.random.default_rng(2)
+    params = make_params(rng)
+    x = jnp.asarray(rng.normal(size=(16, D)), jnp.float32)
+
+    def old_stage_fn(p, x):  # strictly 2-arg
+        return jax.nn.relu(x @ p["w"] + p["b"])
+
+    def fn(params, x):
+        stage_params = jax.tree.map(lambda a: a[0], params)
+        mb = x.reshape(4, -1, D)
+        out = gpipe(old_stage_fn, stage_params, mb, axis="model")
+        return last_stage_value(out).reshape(x.shape)
+
+    jitted = jax.jit(shard_map(
+        fn, mesh=mesh,
+        in_specs=({"w": P("model"), "b": P("model")}, P("data")),
+        out_specs=P("data"), check_vma=False,
+    ))
+    out = jitted(
+        jax.device_put(params, jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            {"w": P("model"), "b": P("model")})),
+        jax.device_put(x, NamedSharding(mesh, P("data"))),
+    )
+    ref = sequential(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gpipe_defaulted_third_arg_not_misbound(devices8):
+    """A legacy stage_fn with an unrelated defaulted third parameter
+    (``train=False``) must NOT receive the traced mb_idx in it."""
+    mesh = make_mesh(devices8, data_parallel=2, model_parallel=4)
+    rng = np.random.default_rng(3)
+    params = make_params(rng)
+    x = jnp.asarray(rng.normal(size=(16, D)), jnp.float32)
+
+    def legacy_fn(p, x, train=False):
+        assert train is False  # a tracer here would mean misbinding
+        return jax.nn.relu(x @ p["w"] + p["b"])
+
+    def fn(params, x):
+        stage_params = jax.tree.map(lambda a: a[0], params)
+        out = gpipe(legacy_fn, stage_params, x.reshape(4, -1, D), axis="model")
+        return last_stage_value(out).reshape(x.shape)
+
+    jitted = jax.jit(shard_map(
+        fn, mesh=mesh,
+        in_specs=({"w": P("model"), "b": P("model")}, P("data")),
+        out_specs=P("data"), check_vma=False,
+    ))
+    out = jitted(
+        jax.device_put(params, jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            {"w": P("model"), "b": P("model")})),
+        jax.device_put(x, NamedSharding(mesh, P("data"))),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(sequential(params, x)),
+                               rtol=1e-5, atol=1e-6)
