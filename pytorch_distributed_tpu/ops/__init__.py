@@ -15,7 +15,13 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.metrics import topk_correct, ClassificationMetrics
-from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay, build_optimizer
+from pytorch_distributed_tpu.ops.optim import (
+    sgd_with_weight_decay,
+    build_optimizer,
+    clip_by_global_norm,
+    clip_grads_by_global_norm,
+    sharded_global_norm,
+)
 from pytorch_distributed_tpu.ops.precision import (
     Policy,
     DynamicLossScaler,
@@ -32,6 +38,9 @@ __all__ = [
     "ClassificationMetrics",
     "sgd_with_weight_decay",
     "build_optimizer",
+    "clip_by_global_norm",
+    "clip_grads_by_global_norm",
+    "sharded_global_norm",
     "Policy",
     "DynamicLossScaler",
     "NoOpLossScaler",

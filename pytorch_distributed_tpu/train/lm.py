@@ -28,6 +28,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.ops.optim import (
+    clip_grads_by_global_norm,
+    spec_axes,
+)
 from pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -225,6 +229,7 @@ def make_lm_train_step(
     state_specs: Optional[TrainState] = None,
     config=None,
     dropout_seed: int = 0,
+    grad_clip_norm: float = 0.0,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -309,16 +314,23 @@ def make_lm_train_step(
             # data) owns its gradient there; psum only over the axes its
             # spec does NOT shard.
             def _reduce(g, spec):
-                named = set()
-                for part in spec:
-                    if part is None:
-                        continue
-                    named.update(part if isinstance(part, tuple) else (part,))
+                named = spec_axes(spec)
                 ax = tuple(a for a in axes if a not in named)
                 return jax.lax.psum(g, ax) if ax else g
 
             grads = jax.tree.map(_reduce, grads, state_specs.params)
         count = global_count
+
+        grad_norm = None
+        if grad_clip_norm:
+            # After the reduction above each leaf's grad is complete for
+            # its own shard and replicated elsewhere — exactly the
+            # precondition sharded_global_norm expects (it psums square-
+            # sums over the axes each spec shards).
+            grads, grad_norm = clip_grads_by_global_norm(
+                grads, grad_clip_norm,
+                state_specs.params if state_specs is not None else None,
+            )
 
         updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(jnp.add, state.params, updates)
@@ -328,6 +340,8 @@ def make_lm_train_step(
             opt_state=new_opt_state,
         )
         metrics = {"loss": loss, "tokens": count}
+        if grad_norm is not None:
+            metrics["grad_norm"] = grad_norm  # PRE-clip norm observable
         moe_stats = jax.tree.leaves(mutated.get("moe_stats", {}))
         if moe_stats:
             # mean over MoE layers, then over shards: the observable for

@@ -86,6 +86,11 @@ class LMTrainerConfig:
     prefetch: int = 2
     seed: int = 0
     suspend_sync_every: int = 1  # see TrainerConfig.suspend_sync_every
+    # Global-norm gradient clipping (0 = off). Correct under ANY sharding:
+    # the norm psums each leaf's square-sum over the axes its spec shards
+    # (ops.optim.sharded_global_norm) — the loss-spike control the
+    # reference's SGD ResNet never needed but an LM does.
+    grad_clip_norm: float = 0.0
 
 
 class LMTrainer(SuspendableTrainer):
@@ -146,7 +151,7 @@ class LMTrainer(SuspendableTrainer):
         )
         self.train_step = make_lm_train_step(
             self.mesh, state_specs=self.state_specs, config=model_config,
-            dropout_seed=config.seed,
+            dropout_seed=config.seed, grad_clip_norm=config.grad_clip_norm,
         )
         self.eval_step = make_lm_eval_step(
             self.mesh, state_specs=self.state_specs, config=model_config

@@ -48,6 +48,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_tpu.models.transformer import Block, TransformerConfig
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.ops.optim import clip_grads_by_global_norm
 from pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -312,6 +313,7 @@ def make_pp_lm_train_step(
     data_axis: str = DATA_AXIS,
     axis: str = MODEL_AXIS,
     dropout_seed: int = 0,
+    grad_clip_norm: float = 0.0,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Compiled PP train step over a (data, stage[, model]) mesh.
 
@@ -410,6 +412,16 @@ def make_pp_lm_train_step(
             "head": jax.lax.psum(grads["head"], axis),
         }
         grads = jax.lax.psum(grads, data_axis)
+
+        if grad_clip_norm:
+            # Stage-stacked leaves are local to their stage (specs name
+            # the stage axis; TP-within-PP leaves also name the model
+            # axis) — sharded_global_norm psums their square-sums over
+            # exactly those axes, so every stage clips by the same global
+            # norm the sequential model would compute.
+            grads, _ = clip_grads_by_global_norm(
+                grads, grad_clip_norm, state_specs.params
+            )
 
         updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree.map(jnp.add, state.params, updates)

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.metrics import ClassificationMetrics
 from pytorch_distributed_tpu.ops.precision import NoOpLossScaler, all_finite
+from pytorch_distributed_tpu.ops.optim import clip_grads_by_global_norm
 from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, shard_map
 from pytorch_distributed_tpu.train.state import TrainState
 
@@ -63,6 +64,7 @@ def make_train_step(
     axis: str = DATA_AXIS,
     label_smoothing: float = 0.0,
     state_specs: Optional[TrainState] = None,
+    grad_clip_norm: float = 0.0,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build the compiled training step for a mesh.
 
@@ -112,6 +114,17 @@ def make_train_step(
             grads = scatter_grads(grads, state_specs.params, axis)
         else:
             grads = jax.lax.pmean(grads, axis_name=axis)
+
+        if grad_clip_norm:
+            # torch ordering (clip_grad_norm_ after scaler.unscale_): the
+            # threshold must see TRUE gradient magnitudes, so this sits
+            # after unscale_grads and after the cross-replica combine.
+            # Non-finite grads survive clipping as NaN (inf * 0) and the
+            # scaler's finite gate below still skips the step.
+            grads, _ = clip_grads_by_global_norm(
+                grads, grad_clip_norm,
+                state_specs.params if fsdp else None,
+            )
 
         new_batch_stats = mutated.get("batch_stats", state.batch_stats)
         if new_batch_stats:

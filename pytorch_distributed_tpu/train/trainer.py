@@ -74,6 +74,9 @@ class TrainerConfig:
     # FSDP/ZeRO-3: shard params+optimizer over the data axis (~axis-size
     # less state memory; identical training math — parallel/fsdp.py).
     fsdp: bool = False
+    # Global-norm gradient clipping (0 = off); sharding-correct under FSDP
+    # (ops.optim.sharded_global_norm), applied after scaler unscale.
+    grad_clip_norm: float = 0.0
 
 
 class Trainer(SuspendableTrainer):
@@ -168,6 +171,7 @@ class Trainer(SuspendableTrainer):
             self.mesh,
             label_smoothing=config.label_smoothing,
             state_specs=self.state_specs,
+            grad_clip_norm=config.grad_clip_norm,
         )
         self.eval_step = make_eval_step(self.mesh, state_specs=self.state_specs)
         # pre-fault the checkpoint snapshot arena while the first step

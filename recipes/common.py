@@ -176,6 +176,12 @@ def parse_lm_args(description: str) -> argparse.Namespace:
     p.add_argument("--embed-dim", type=int, default=768)
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--grad-clip-norm", type=float, default=0.0,
+                   help="global-norm gradient clip (0 = off, the pre-r4 "
+                        "behavior so published trajectories stay "
+                        "reproducible; 1.0 is the usual LM setting). The "
+                        "norm is sharding-correct under TP/SP/FSDP "
+                        "(ops.optim.sharded_global_norm)")
     p.add_argument("--attention", default="flash",
                    choices=["dense", "blockwise", "flash", "ring",
                             "ring_flash"],

@@ -1,0 +1,269 @@
+"""Global-norm gradient clipping under every sharding (VERDICT r3 #2).
+
+The reference never clipped (SGD ResNet, ``restnet_ddp.py:122``); an LM
+framework must, and under this repo's shard_map steps the global norm is
+only correct if each leaf's square-sum is psum'd over exactly the axes its
+PartitionSpec shards (ops.optim.sharded_global_norm). These tests pin:
+
+- norm parity with optax.global_norm on replicated trees;
+- clipped-update parity of FSDP vs replicated DP (data-sharded leaves);
+- clipped-update parity of TP(+SP) vs a single-device reference
+  (Megatron-sharded leaves must psum over the model axis);
+- clipped-update parity of PP vs the sequential microbatched reference
+  (stage-stacked leaves must psum over the stage axis);
+- fp16 scaler ordering: the clip threshold sees UNSCALED magnitudes
+  (torch's scaler.unscale_-then-clip contract).
+
+Each parity test clips hard (max_norm well below the true norm) so a
+wrong norm — e.g. a missing cross-shard psum — would change every update
+and blow past the tolerances.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+from pytorch_distributed_tpu.models.transformer import tiny_config
+from pytorch_distributed_tpu.ops.optim import (
+    clip_by_global_norm,
+    clip_grads_by_global_norm,
+    sgd_with_weight_decay,
+    sharded_global_norm,
+)
+from pytorch_distributed_tpu.parallel import (
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+    shard_fsdp_state,
+)
+from pytorch_distributed_tpu.train.lm import (
+    create_lm_state,
+    make_lm_train_step,
+    shard_lm_state,
+    shift_labels,
+)
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.step import make_train_step
+
+CLIP = 0.05  # far below the true grad norms here -> always triggers
+
+
+def test_sharded_global_norm_matches_optax_on_replicated():
+    rng = np.random.default_rng(0)
+    tree = {
+        "a": jnp.asarray(rng.normal(size=(8, 16)), jnp.float32),
+        "b": {"c": jnp.asarray(rng.normal(size=(5,)), jnp.float32)},
+    }
+    ours = float(sharded_global_norm(tree))
+    ref = float(optax.global_norm(tree))
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+    clipped, pre = clip_grads_by_global_norm(tree, 0.1)
+    np.testing.assert_allclose(float(pre), ref, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(optax.global_norm(clipped)), 0.1, rtol=1e-5
+    )
+    # under the threshold: identity
+    same, _ = clip_grads_by_global_norm(tree, 1e9)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        same, tree,
+    )
+
+
+def test_clip_transform_in_optax_chain_keeps_state_structure():
+    tx_plain = sgd_with_weight_decay(0.1, momentum=0.9)
+    tx_clip = optax.chain(clip_by_global_norm(CLIP), tx_plain)
+    params = {"w": jnp.ones((4, 4))}
+    # EmptyState prepended; the wrapped optimizer's state is untouched
+    s_plain = tx_plain.init(params)
+    s_clip = tx_clip.init(params)
+    assert len(s_clip) == 2
+    assert (jax.tree.structure(s_clip[1])
+            == jax.tree.structure(s_plain))
+
+
+# ---------------------------------------------------------------- FSDP
+
+def _tiny_resnet():
+    return ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=10,
+                  num_filters=16)
+
+
+def _image_batch(mesh, n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return shard_batch(mesh, {
+        "image": rng.normal(size=(n, 16, 16, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, n).astype(np.int32),
+    })
+
+
+def test_clip_fsdp_matches_replicated(devices8):
+    mesh = make_mesh(devices8)
+    tx = sgd_with_weight_decay(0.1, momentum=0.9, weight_decay=1e-4)
+
+    def run(fsdp, clip, steps=3):
+        state = TrainState.create(_tiny_resnet(), tx, jax.random.key(0),
+                                  (1, 16, 16, 3))
+        if fsdp:
+            state, specs = shard_fsdp_state(mesh, state)
+        else:
+            state = jax.device_put(state, replicated_sharding(mesh))
+            specs = None
+        step = make_train_step(mesh, state_specs=specs, grad_clip_norm=clip)
+        for i in range(steps):
+            state, _ = step(state, _image_batch(mesh, seed=i))
+        return state
+
+    state_f = run(True, CLIP)
+    state_r = run(False, CLIP)
+    flat_r = {str(p): v for p, v in
+              jax.tree_util.tree_leaves_with_path(state_r.params)}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state_f.params):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_r[str(path)]),
+            rtol=1e-4, atol=1e-6, err_msg=str(path),
+        )
+    # power check: the clip actually bit (an unclipped run differs)
+    state_u = run(False, 0.0)
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(jax.tree.leaves(state_u.params),
+                        jax.tree.leaves(state_r.params))
+    ]
+    assert max(diffs) > 1e-4
+
+
+# ------------------------------------------------------------------ TP
+
+def _lm_batch(mesh, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, 128, (4, 32)).astype(np.int32)
+    labels, weights = shift_labels(tokens)
+    sh = NamedSharding(mesh, P("data", "seq"))
+    return {
+        "tokens": jax.device_put(tokens, sh),
+        "labels": jax.device_put(labels, sh),
+        "weights": jax.device_put(weights, sh),
+    }
+
+
+def test_clip_tp_matches_single_device(devices8):
+    tx = sgd_with_weight_decay(0.1, momentum=0.9)
+
+    def run(mesh, cfg, steps=3):
+        state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+        state, specs = shard_lm_state(mesh, state, cfg)
+        step = make_lm_train_step(mesh, state_specs=specs, config=cfg,
+                                  grad_clip_norm=CLIP)
+        losses = []
+        for i in range(steps):
+            state, m = step(state, _lm_batch(mesh, seed=i))
+            losses.append(float(m["loss"]))
+        return state, losses, float(m["grad_norm"])
+
+    mesh_tp = make_mesh(devices8, data_parallel=2, seq_parallel=2,
+                        model_parallel=2)
+    cfg_tp = tiny_config(attention="ring", model_axis="model", tp_size=2)
+    mesh_1 = make_mesh(devices8[:1])
+    cfg_1 = tiny_config(attention="dense")
+
+    state_tp, losses_tp, gnorm_tp = run(mesh_tp, cfg_tp)
+    state_1, losses_1, gnorm_1 = run(mesh_1, cfg_1)
+    np.testing.assert_allclose(losses_tp, losses_1, rtol=5e-4)
+    # the PRE-clip global norm itself must agree across shardings — this
+    # is the direct probe of the cross-shard psum
+    np.testing.assert_allclose(gnorm_tp, gnorm_1, rtol=5e-4)
+    flat_1 = {str(p): v for p, v in
+              jax.tree_util.tree_leaves_with_path(state_1.params)}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state_tp.params):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_1[str(path)]),
+            rtol=2e-3, atol=3e-5, err_msg=str(path),
+        )
+
+
+# ------------------------------------------------------------------ PP
+
+def test_clip_pp_matches_sequential_reference(devices8):
+    from pytorch_distributed_tpu.train.pp import (
+        create_pp_lm_state,
+        make_pp_lm_train_step,
+        make_pp_reference_step,
+        shard_pp_state,
+    )
+
+    cfg = tiny_config(num_layers=4)
+    tx = sgd_with_weight_decay(0.1, momentum=0.9)
+    # the reference clips via the optax-chain form on replicated grads —
+    # the independently-correct formulation
+    tx_ref = optax.chain(clip_by_global_norm(CLIP),
+                         sgd_with_weight_decay(0.1, momentum=0.9))
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=1,
+                     model_parallel=4)
+    n_stages = 4
+
+    state0 = create_pp_lm_state(cfg, n_stages, tx, jax.random.key(0),
+                                init_len=32)
+    state_ref = create_pp_lm_state(cfg, n_stages, tx_ref, jax.random.key(0),
+                                   init_len=32)
+    state_pp, specs = shard_pp_state(mesh, state0)
+    step_pp = make_pp_lm_train_step(mesh, cfg, specs, n_microbatches=2,
+                                    grad_clip_norm=CLIP)
+    step_ref = make_pp_reference_step(cfg, n_stages, tx_ref,
+                                      n_microbatches=2)
+
+    rng = np.random.default_rng(7)
+    sh = NamedSharding(mesh, P("data"))
+    for i in range(3):
+        tokens = rng.integers(1, 128, (4, 32)).astype(np.int32)
+        labels, weights = shift_labels(tokens)
+        b = {"tokens": tokens, "labels": labels, "weights": weights}
+        state_pp, m_pp = step_pp(
+            state_pp, {k: jax.device_put(v, sh) for k, v in b.items()}
+        )
+        state_ref, m_ref = step_ref(state_ref, b)
+        np.testing.assert_allclose(float(m_pp["loss"]), float(m_ref["loss"]),
+                                   rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(b), rtol=2e-3,
+            atol=2e-4,
+        ),
+        jax.device_get(state_pp.params), jax.device_get(state_ref.params),
+    )
+
+
+# ------------------------------------------------------- fp16 scaler
+
+def test_clip_sees_unscaled_grads(devices8):
+    """torch contract: scaler.unscale_() THEN clip. With a 2^8 loss scale
+    (exact in fp32), a scaled-and-unscaled run must track the scalerless
+    run bit-closely — if the clip saw scaled magnitudes its threshold
+    would bite 256x harder and the trajectories would diverge."""
+    from pytorch_distributed_tpu.ops.precision import DynamicLossScaler
+
+    mesh = make_mesh(devices8)
+    tx = sgd_with_weight_decay(0.1, momentum=0.9)
+
+    def run(scaler, steps=3):
+        state = TrainState.create(_tiny_resnet(), tx, jax.random.key(0),
+                                  (1, 16, 16, 3), scaler=scaler)
+        state = jax.device_put(state, replicated_sharding(mesh))
+        step = make_train_step(mesh, grad_clip_norm=CLIP)
+        for i in range(steps):
+            state, m = step(state, _image_batch(mesh, seed=i))
+            assert float(m["grads_finite"]) == 1.0
+        return state
+
+    state_s = run(DynamicLossScaler.create(init_scale=2.0**8))
+    state_p = run(None)
+    for a, b in zip(jax.tree.leaves(state_s.params),
+                    jax.tree.leaves(state_p.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
