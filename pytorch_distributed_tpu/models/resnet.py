@@ -250,8 +250,8 @@ class _MomentBatchNorm(nn.Module):
         return scale, beta - mean * scale
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(5,))
-def _fused_expand_tail(z2, residual, w, gamma, beta, epsilon):
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _fused_expand_tail(z2, residual, w, gamma, beta, epsilon, axis=None):
     """``relu(bn(conv1x1(z2, w)) + residual)`` with batch stats from input
     moments, and a hand-written backward.
 
@@ -264,9 +264,18 @@ def _fused_expand_tail(z2, residual, w, gamma, beta, epsilon):
     materializes the wide intermediates twice (measured +16 ms/step on
     the v5e ResNet-50 train step vs this formulation).
 
+    ``axis``: mesh axis name for sync-BN under shard_map. The input
+    moments are additive, so the forward psums (Σz, zᵀz) once; the
+    backward mirrors what autodiff-through-psum would produce — LOCAL
+    cotangents for the param grads (the trainer's cross-replica pmean
+    completes them) and PSUM'd (dmean, dvar) for the activation grad,
+    because the psum'd statistics make every replica's loss depend on
+    this shard's input.
+
     Returns ``(out, batch_mean, batch_var)``.
     """
-    return _fused_expand_tail_fwd(z2, residual, w, gamma, beta, epsilon)[0]
+    return _fused_expand_tail_fwd(z2, residual, w, gamma, beta, epsilon,
+                                  axis)[0]
 
 
 _NHWC_1x1 = ("NHWC", "HWIO", "NHWC")
@@ -280,22 +289,30 @@ def _conv1x1(x, w2d, strides=(1, 1)):
     )
 
 
-def _moments_nhwc(x):
-    """(Σx, xᵀx) over (B,H,W) of an NHWC tensor, fp32 accumulation.
+def _moments_nhwc(x, axis=None):
+    """(Σx, xᵀx, n) over (B,H,W) of an NHWC tensor, fp32 accumulation.
+
+    ``axis``: sync-BN mesh axis — the moments are additive, so one psum
+    makes them (and the element count n) global; this is the ONE site
+    that owns the cross-replica reduction for every moment-path consumer.
 
     Rank-4 contractions on purpose: collapsing B,H,W with a reshape
     changes the tensor's second-to-last dim and forces a physical
     retiling copy on TPU (measured: flattening these [*,F] operands cost
     +8 ms/step on the v5e ResNet-50 step)."""
+    n = x.shape[0] * x.shape[1] * x.shape[2]
     s = jnp.sum(x, axis=(0, 1, 2), dtype=jnp.float32)
     m2 = jax.lax.dot_general(
         x, x, (((0, 1, 2), (0, 1, 2)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    return s, m2
+    if axis is not None:
+        s, m2 = jax.lax.psum((s, m2), axis)
+        n = n * jax.lax.psum(1, axis)
+    return s, m2, n
 
 
-def _fused_expand_tail_fwd(z2, residual, w, gamma, beta, epsilon):
+def _fused_expand_tail_fwd(z2, residual, w, gamma, beta, epsilon, axis=None):
     # Two measured dead ends are worth recording here: (1) a Pallas
     # one-pass version of these reductions (ops/bottleneck_tail.py) was
     # SLOWER in the full step — the custom-call boundary costs XLA its
@@ -303,12 +320,14 @@ def _fused_expand_tail_fwd(z2, residual, w, gamma, beta, epsilon):
     # ones-channel augmentation folding Σz2/Σgp into the contractions
     # broke lane alignment (65 channels pads to 128 lanes, doubling the
     # bytes of every pass at stage 1/2) for +7 ms. See PERF_NOTES.md.
-    n = z2.shape[0] * z2.shape[1] * z2.shape[2]
     dt = z2.dtype
-    s, m2 = _moments_nhwc(z2)
+    # sync-BN (axis set): _moments_nhwc psums the additive moments once;
+    # everything downstream sees global statistics.
+    s, m2, n = _moments_nhwc(z2, axis)
     m = s / n
+    m2n = m2 / n  # E[z zᵀ], global when syncing
     mean = m @ w
-    ey2 = jnp.sum((m2 / n) @ w * w, axis=0)
+    ey2 = jnp.sum(m2n @ w * w, axis=0)
     var = ey2 - mean * mean
     sigma_inv = jax.lax.rsqrt(var + epsilon)
     a = gamma * sigma_inv
@@ -316,14 +335,13 @@ def _fused_expand_tail_fwd(z2, residual, w, gamma, beta, epsilon):
 
     y3 = _conv1x1(z2, w)
     out = jax.nn.relu(y3 * a.astype(dt) + b.astype(dt) + residual.astype(dt))
-    saved = (z2, w, gamma, m, m2, mean, var, sigma_inv, a, out)
+    saved = (z2, w, gamma, m, m2n, n, mean, var, sigma_inv, a, out)
     return (out, mean, var), saved
 
 
-def _fused_expand_tail_bwd(epsilon, saved, cotangents):
+def _fused_expand_tail_bwd(epsilon, axis, saved, cotangents):
     g, g_mean, g_var = cotangents
-    z2, w, gamma, m, m2, mean, var, sigma_inv, a, out = saved
-    n = z2.shape[0] * z2.shape[1] * z2.shape[2]
+    z2, w, gamma, m, m2n, n, mean, var, sigma_inv, a, out = saved
 
     gp = jnp.where(out > 0, g, 0)  # [B,h,w,E]; also IS the residual grad
     # One skinny contraction carries the conv weight grad AND the BN
@@ -337,12 +355,21 @@ def _fused_expand_tail_bwd(epsilon, saved, cotangents):
     a_grad = sa - mean * sb  # dL/da
     dgamma = a_grad * sigma_inv
     dbeta = sb
+    # Param grads (dgamma/dbeta/dw) use LOCAL cotangents — the trainer's
+    # cross-replica grad combine completes them, exactly as it would for
+    # autodiff of a psum'd-stats forward. m/m2n/mean/sigma are global
+    # forward VALUES, so the formulas are unchanged.
     dvar = -0.5 * a_grad * gamma * sigma_inv**3 + g_var
     dmean = -a * sb - 2.0 * mean * dvar + g_mean
+    dw = p * a + jnp.outer(m, dmean) + 2.0 * m2n @ w * dvar
+
+    # The ACTIVATION grad needs the psum: the transposed moment-psum
+    # delivers every replica's (dmean, dvar) back to this shard's z2.
+    if axis is not None:
+        dmean, dvar = jax.lax.psum((dmean, dvar), axis)
     dm = w @ dmean  # [F]
     # dM is symmetric: w·diag(dvar)·wᵀ/n
     dm2 = (w * dvar) @ w.T / n  # [F, F]
-    dw = p * a + jnp.outer(m, dmean) + 2.0 * (m2 / n) @ w * dvar
 
     dt = z2.dtype
     # Both wide matmuls stay 1x1 NHWC convs (layout, see _moments_nhwc);
@@ -358,7 +385,7 @@ def _fused_expand_tail_bwd(epsilon, saved, cotangents):
 _fused_expand_tail.defvjp(_fused_expand_tail_fwd, _fused_expand_tail_bwd)
 
 
-def _expand_bn_stats(z2f, w):
+def _expand_bn_stats(z2f, w, axis=None):
     """Exact batch stats of ``conv1x1(z, w)`` from the moments of ``z``.
 
     The 1x1 expand conv is linear, so with ``m = E[z]`` and
@@ -375,8 +402,9 @@ def _expand_bn_stats(z2f, w):
     Variance via E[y²]−E[y]², flax's fast-variance formula. ``z`` is NHWC
     (rank-4 contraction — see ``_moments_nhwc`` for why not flattened).
     """
-    n = z2f.shape[0] * z2f.shape[1] * z2f.shape[2]
-    s, m2 = _moments_nhwc(z2f)
+    # sync-BN (axis set): psum'd inside _moments_nhwc; autodiff transposes
+    # the psum itself, so this path needs no hand-written backward
+    s, m2, n = _moments_nhwc(z2f, axis)
     mean = (s / n) @ w
     ey2 = jnp.sum((m2 / n) @ w * w, axis=0)
     return mean, ey2 - mean * mean
@@ -404,6 +432,10 @@ class FusedBottleneckBlock(nn.Module):
     dtype: Any = jnp.float32
     momentum: float = 0.9
     epsilon: float = 1e-5
+    # sync-BN mesh axis for the moment-path stats (BN3 + downsample);
+    # BN0/BN1 sync via the ``norm`` partial's own axis_name. None = local
+    # per-replica statistics, the reference's DDP semantics.
+    bn_cross_replica_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -424,7 +456,9 @@ class FusedBottleneckBlock(nn.Module):
             wd = _Kernel1x1(f * e, name="downsample_conv")(x.shape[-1])[0, 0]
             if train:
                 xs = x[:, :: self.strides, :: self.strides, :]
-                ds_mean, ds_var = _expand_bn_stats(xs, wd)
+                ds_mean, ds_var = _expand_bn_stats(
+                    xs, wd, self.bn_cross_replica_axis
+                )
             else:
                 ds_mean = ds_var = None
             scaled, biasd = _MomentBatchNorm(
@@ -440,7 +474,8 @@ class FusedBottleneckBlock(nn.Module):
         def run_tail(gamma, beta, ra_mean, ra_var):
             if ra_mean is None:  # train: stats from moments inside the vjp
                 return _fused_expand_tail(
-                    z2, residual, w3, gamma, beta, self.epsilon
+                    z2, residual, w3, gamma, beta, self.epsilon,
+                    self.bn_cross_replica_axis,
                 )
             scale = gamma * jax.lax.rsqrt(ra_var + self.epsilon)
             bias = beta - ra_mean * scale
@@ -579,14 +614,6 @@ class ResNet(nn.Module):
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
 
         fused = self.fused_bottleneck and self.block_cls is BottleneckBlock
-        if fused and self.bn_cross_replica_axis is not None:
-            raise NotImplementedError(
-                "fused_bottleneck computes BN3/downsample batch stats from "
-                "local input moments and does not psum them across "
-                f"'{self.bn_cross_replica_axis}'; sync-BN needs the plain "
-                "blocks (fused_bottleneck=False). (The moments are "
-                "additive, so a psum'd variant is possible — unbuilt.)"
-            )
         block_cls = FusedBottleneckBlock if fused else self.block_cls
         if self.remat_blocks:
             block_cls = nn.remat(block_cls, static_argnums=(2,) if fused else ())
@@ -600,6 +627,7 @@ class ResNet(nn.Module):
                         norm=norm,
                         strides=strides,
                         dtype=self.dtype,
+                        bn_cross_replica_axis=self.bn_cross_replica_axis,
                         name=f"stage{i + 1}_block{j + 1}",
                     )(x, train)
                 else:

@@ -176,10 +176,121 @@ def test_fused_torch_import_parity():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
-def test_fused_rejects_sync_bn():
-    """fused_bottleneck computes local-moment stats; combining it with
-    cross-replica sync-BN must fail loudly, not silently diverge."""
-    with pytest.raises(NotImplementedError, match="sync-BN"):
-        resnet50(fused_bottleneck=True, bn_cross_replica_axis="data").init(
-            jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32)
-        )
+@pytest.mark.parametrize("strides", [1, 2])
+def test_fused_sync_bn_matches_plain_sync_bn(devices8, strides):
+    """Sync-BN × fused bottleneck (VERDICT r3 #5): with the moment psum
+    across the data axis, the fused block's outputs, global batch stats,
+    and pmean'd grads match flax's own sync-BN on the plain block — the
+    hand-written vjp must reproduce autodiff-through-psum exactly."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.mesh import shard_map
+
+    mesh = make_mesh(devices8)  # 8-way data axis
+    conv = partial(
+        nn.Conv, use_bias=False, padding="SAME", dtype=jnp.float32,
+        kernel_init=conv_kernel_init,
+    )
+    norm = partial(
+        nn.BatchNorm, use_running_average=False, momentum=0.9,
+        epsilon=1e-5, dtype=jnp.float32, axis_name="data",
+    )
+    plain = BottleneckBlock(filters=8, conv=conv, norm=norm, strides=strides)
+    fused = FusedBottleneckBlock(filters=8, conv=conv, norm=norm,
+                                 strides=strides,
+                                 bn_cross_replica_axis="data")
+
+    x_np = np.random.default_rng(5).standard_normal((16, 8, 8, 16)).astype(
+        np.float32
+    )
+    x = jax.device_put(jnp.asarray(x_np), NamedSharding(mesh, P("data")))
+
+    # init needs the axis bound too — run it inside a shard_map
+    def init_fn(x):
+        return plain.init(jax.random.key(0), x)
+
+    v = jax.jit(shard_map(init_fn, mesh=mesh, in_specs=(P("data"),),
+                          out_specs=P(), check_vma=False))(x)
+
+    def run(model, *extra):
+        def f(v, x):
+            out, mut = model.apply(v, x, *extra, mutable=["batch_stats"])
+            g = jax.grad(
+                lambda p: jnp.sum(
+                    model.apply(
+                        {"params": p, "batch_stats": v["batch_stats"]},
+                        x, *extra, mutable=["batch_stats"],
+                    )[0] ** 2
+                )
+            )(v["params"])
+            # local direct terms differ per replica; the trainer's pmean
+            # is what makes them comparable
+            return out, mut, jax.lax.pmean(g, "data")
+
+        return jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(), P("data")),
+            out_specs=(P("data"), P(), P()), check_vma=False,
+        ))(v, x)
+
+    op, mp_, gp_ = run(plain)
+    of, mf, gf = run(fused, True)
+
+    np.testing.assert_allclose(np.asarray(of), np.asarray(op), atol=2e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        mf, mp_,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b),
+            rtol=1e-4, atol=1e-4 * max(float(jnp.abs(a).max()), 1e-3),
+        ),
+        gf, gp_,
+    )
+    # and the synced stats really are GLOBAL: they match a single-device
+    # stats pass over the full batch (plain non-sync path, whole x)
+    _, m_full = BottleneckBlock(
+        filters=8, conv=conv,
+        norm=partial(nn.BatchNorm, use_running_average=False, momentum=0.9,
+                     epsilon=1e-5, dtype=jnp.float32, axis_name=None),
+        strides=strides,
+    ).apply(v, jnp.asarray(x_np), mutable=["batch_stats"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        mf, m_full,
+    )
+
+
+def test_resnet_fused_sync_bn_initializes_and_runs(devices8):
+    """The r3 guard is gone: fused_bottleneck composes with sync-BN at the
+    model level (a pod run no longer chooses between the fused perf path
+    and cross-replica BN statistics)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_tpu.models.resnet import ResNet
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.mesh import shard_map
+
+    mesh = make_mesh(devices8)
+    model = ResNet(stage_sizes=(1, 1), block_cls=BottleneckBlock,
+                   num_classes=10, num_filters=8, fused_bottleneck=True,
+                   bn_cross_replica_axis="data")
+    x = jax.device_put(
+        jnp.asarray(np.random.default_rng(6).standard_normal(
+            (8, 16, 16, 3)), jnp.float32),
+        NamedSharding(mesh, P("data")),
+    )
+
+    def f(x):
+        v = model.init(jax.random.key(0), x)
+        out, _ = model.apply(v, x, train=True, mutable=["batch_stats"])
+        return out
+
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                            out_specs=P("data"), check_vma=False))(x)
+    assert np.isfinite(np.asarray(out)).all()
