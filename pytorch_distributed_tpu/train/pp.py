@@ -31,10 +31,15 @@ INSIDE stages when the mesh carries a separate ``stage`` axis (stage
 params stack-shard on ``stage`` AND Megatron-shard on ``model`` via
 ``TRANSFORMER_TP_RULES``); MoE blocks run inside stages with their
 load-balancing aux losses accumulated only over REAL pipeline ticks
-(garbage warm-up/drain contributions masked, gradients included). MoE
-expert parallelism (expert_axis) under PP stays guarded: EP rides the
-data axis, and dispatch inside a pipeline tick across the data axis is
-untested — experts replicate within a stage instead.
+(garbage warm-up/drain contributions masked, gradients included).
+
+Round-4 closes the last composability cell — EP-under-PP: experts shard
+over the data axis inside each stage, the all_to_all exchange runs inside
+every gpipe tick (all data ranks at a stage execute ticks in lockstep, so
+the collective is matched; garbage-tick exchanges carry garbage and are
+masked like every other warm-up/drain product), and the data-axis grad
+combine is spec-aware so expert grads — already complete after the
+transposed all_to_all — are not double-summed.
 """
 
 from __future__ import annotations
@@ -48,7 +53,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_tpu.models.transformer import Block, TransformerConfig
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from pytorch_distributed_tpu.ops.optim import clip_grads_by_global_norm
+from pytorch_distributed_tpu.ops.optim import (
+    clip_grads_by_global_norm,
+    spec_axes,
+)
 from pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -126,13 +134,6 @@ def create_pp_lm_state(
         raise ValueError(
             f"num_layers {config.num_layers} not divisible by n_stages {n_stages}"
         )
-    if config.n_experts and config.expert_axis is not None:
-        raise NotImplementedError(
-            "MoE EXPERT PARALLELISM under PP is unsupported (EP rides the "
-            "data axis; dispatch across it inside a pipeline tick is "
-            "untested). Clear expert_axis/ep_size — experts then replicate "
-            "within each stage, which PP supports."
-        )
     lps = config.num_layers // n_stages
     if config.n_experts and lps % config.moe_every:
         raise ValueError(
@@ -143,12 +144,14 @@ def create_pp_lm_state(
     length = init_len or min(config.max_seq_len, 128)
     tokens = jnp.zeros((1, length), jnp.int32)
 
-    # Init twin with TP collectives off: parameter shapes are GLOBAL (the
-    # TP convention throughout — placement shards), and init needs no mesh
-    # axis in scope. Same trick as train.lm.create_lm_state.
+    # Init twin with TP and EP collectives off: parameter shapes are
+    # GLOBAL (the convention throughout — placement shards), and init
+    # needs no mesh axis in scope. Same trick as train.lm.create_lm_state.
     import dataclasses
 
-    init_cfg = dataclasses.replace(config, model_axis=None, tp_size=1)
+    init_cfg = dataclasses.replace(
+        config, model_axis=None, tp_size=1, expert_axis=None, ep_size=1
+    )
 
     embed = PPEmbed(init_cfg)
     e_vars = embed.init(rng, tokens)
@@ -202,27 +205,48 @@ def pp_state_specs(
         and getattr(config, "model_axis", None) is not None
         and config.tp_size > 1
     )
+    use_ep = (
+        config is not None
+        and getattr(config, "n_experts", 0)
+        and getattr(config, "expert_axis", None) is not None
+        and config.ep_size > 1
+    )
     if use_tp and config.model_axis == axis:
         raise ValueError(
             f"TP-within-PP needs distinct axes: stage axis {axis!r} vs "
             f"config.model_axis {config.model_axis!r}"
         )
 
-    def _stage_spec(path, leaf):
-        tail = (None,) * (leaf.ndim - 1)
-        if use_tp:
-            import re
+    # Combined rule set, all shifted right by the stage-stack dim below:
+    # TP rules (canonical MODEL_AXIS remapped to the config's axis) plus
+    # the conditional MoE placements (expert dim over the data axis for
+    # EP, expert hidden dim over the model axis for TP — train/lm.py's
+    # _moe_rules builds them from the config's own axis names).
+    rules: tuple = ()
+    if use_tp:
+        rules += tuple(
+            (pat, tuple(
+                config.model_axis if part == MODEL_AXIS else part
+                for part in spec
+            ))
+            for pat, spec in TRANSFORMER_TP_RULES
+        )
+    if config is not None and getattr(config, "n_experts", 0) and (
+        use_tp or use_ep
+    ):
+        from pytorch_distributed_tpu.train.lm import _moe_rules
 
-            p = path_str(path)
-            for pat, spec in TRANSFORMER_TP_RULES:
-                if re.search(pat, p):
-                    # rules are written against the canonical MODEL_AXIS
-                    # name; remap to the config's axis
-                    tail = tuple(
-                        config.model_axis if part == MODEL_AXIS else part
-                        for part in spec
-                    )
-                    break
+        rules += tuple((pat, tuple(spec)) for pat, spec in _moe_rules(config))
+
+    def _stage_spec(path, leaf):
+        import re
+
+        tail = (None,) * (leaf.ndim - 1)
+        p = path_str(path)
+        for pat, spec in rules:
+            if re.search(pat, p):
+                tail = tuple(spec)
+                break
         return P(*((axis,) + tail))
 
     param_specs = {
@@ -358,6 +382,48 @@ def make_pp_lm_train_step(
                 f"mesh {config.model_axis!r} size "
                 f"{mesh.shape[config.model_axis]} != tp_size {config.tp_size}"
             )
+    if config.n_experts and config.expert_axis is not None:
+        # EP-under-PP: the all_to_all expert exchange runs over the data
+        # axis inside every pipeline tick (all data ranks at a stage run
+        # ticks in lockstep, so the collective is matched).
+        if config.expert_axis != data_axis:
+            raise ValueError(
+                f"expert_axis must be the PP data axis {data_axis!r} "
+                f"(experts shard over it), got {config.expert_axis!r}"
+            )
+        if config.ep_size > 1 and mesh.shape[data_axis] != config.ep_size:
+            raise ValueError(
+                f"ep_size {config.ep_size} must equal the mesh's data axis "
+                f"size {mesh.shape[data_axis]}"
+            )
+        if config.n_experts % max(config.ep_size, 1):
+            raise ValueError(
+                f"n_experts {config.n_experts} not divisible by ep_size "
+                f"{config.ep_size}"
+            )
+        if config.ep_size > 1:
+            # Catch the easy mistake early: shard_pp_state called WITHOUT
+            # config= builds replicated expert specs, and the mismatch
+            # would otherwise surface as an opaque flax shape error at
+            # trace time deep inside MoEMLP.
+            from pytorch_distributed_tpu.parallel.tensor import path_str
+
+            moe_specs = [
+                (path_str(p), s)
+                for p, s in jax.tree_util.tree_flatten_with_path(
+                    state_specs.params["stages"]
+                )[0]
+                if "moe/w_" in path_str(p)
+            ]
+            if moe_specs and not all(
+                config.expert_axis in spec_axes(s) for _, s in moe_specs
+            ):
+                raise ValueError(
+                    "config runs expert parallelism but state_specs' MoE "
+                    f"leaves are not sharded over {config.expert_axis!r}; "
+                    "build the specs with shard_pp_state(mesh, state, "
+                    "config=config) so the EP placement rules apply"
+                )
     lps = config.num_layers // n_stages
     use_dropout = config.dropout > 0.0
 
@@ -411,7 +477,15 @@ def make_pp_lm_train_step(
             "stages": grads["stages"],
             "head": jax.lax.psum(grads["head"], axis),
         }
-        grads = jax.lax.psum(grads, data_axis)
+        # Data-axis combine, spec-aware: an EP leaf (experts sharded over
+        # the data axis) already owns its complete gradient — the bwd
+        # all_to_all returned every rank's contribution to ITS experts —
+        # so psum only leaves whose spec does NOT shard over data.
+        grads = jax.tree.map(
+            lambda g, spec: g if data_axis in spec_axes(spec)
+            else jax.lax.psum(g, data_axis),
+            grads, state_specs.params,
+        )
 
         if grad_clip_norm:
             # Stage-stacked leaves are local to their stage (specs name

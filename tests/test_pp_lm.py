@@ -91,13 +91,14 @@ def test_pp_validations(devices8):
     tx = sgd_with_weight_decay(0.1)
     with pytest.raises(ValueError, match="divisible"):
         create_pp_lm_state(tiny_config(num_layers=3), 4, tx, jax.random.key(0))
-    # expert PARALLELISM under PP is guarded; replicated experts are fine
-    with pytest.raises(NotImplementedError, match="EXPERT PARALLELISM"):
-        create_pp_lm_state(
-            tiny_config(num_layers=4, n_experts=4, moe_every=1,
-                        expert_axis="data", ep_size=2),
-            4, tx, jax.random.key(0),
-        )
+    # expert PARALLELISM under PP is supported since r4: state creation
+    # accepts an EP config (the step validates mesh fit — see
+    # test_pp_ep_validations)
+    create_pp_lm_state(
+        tiny_config(num_layers=4, n_experts=4, moe_every=1,
+                    expert_axis="data", ep_size=2),
+        4, tx, jax.random.key(0), init_len=16,
+    )
     # a TP config sharing the stage axis would psum across stages
     mesh2 = make_mesh(devices8, data_parallel=4, model_parallel=2)
     cfg_tp = tiny_config(num_layers=4, model_axis="model", tp_size=2)
@@ -255,3 +256,137 @@ def test_pp_moe_matches_reference(devices8):
         ),
         jax.device_get(state_pp.params), jax.device_get(state_ref.params),
     )
+
+
+def test_pp_ep_matches_reference(devices8):
+    """EP-under-PP (VERDICT r3 #4, the last composability cell): experts
+    sharded over the data axis inside pipeline stages — the all_to_all
+    dispatch runs inside every gpipe tick — match the sequential
+    replicated-expert reference. Capacity is oversized and the aux weight
+    zeroed so routing is identical across layouts (the same isolation
+    tests/test_moe.py uses for EP-vs-single-device parity)."""
+    import dataclasses
+
+    cfg = tiny_config(num_layers=4, n_experts=2, moe_every=1,
+                      capacity_factor=float(2 * 8), moe_aux_weight=0.0,
+                      expert_axis="data", ep_size=2)
+    cfg_ref = dataclasses.replace(cfg, expert_axis=None, ep_size=1)
+    tx = sgd_with_weight_decay(0.1, momentum=0.9)
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=1,
+                     model_parallel=N_STAGES)
+    state0 = create_pp_lm_state(cfg, N_STAGES, tx, jax.random.key(3),
+                                init_len=32)
+    state_ref = create_pp_lm_state(cfg_ref, N_STAGES, tx, jax.random.key(3),
+                                   init_len=32)
+    state_pp, specs = shard_pp_state(mesh, state0, config=cfg)
+    # expert weights really shard: stage stack on 'model', experts on 'data'
+    w_up_spec = specs.params["stages"]["layer0"]["moe"]["w_up"]
+    assert w_up_spec == P("model", "data", None, None), w_up_spec
+    w_up = state_pp.params["stages"]["layer0"]["moe"]["w_up"]
+    assert {s.data.shape for s in w_up.addressable_shards} == {
+        (1, 1) + w_up.shape[2:]
+    }
+    step_pp = make_pp_lm_train_step(mesh, cfg, specs, n_microbatches=2)
+    step_ref = make_pp_reference_step(cfg_ref, N_STAGES, tx, n_microbatches=2)
+    sh = NamedSharding(mesh, P("data"))
+    for i in range(3):
+        b = batch_np(seed=30 + i)
+        state_pp, m_pp = step_pp(
+            state_pp, {k: jax.device_put(v, sh) for k, v in b.items()}
+        )
+        state_ref, m_ref = step_ref(state_ref, b)
+        np.testing.assert_allclose(float(m_pp["loss"]), float(m_ref["loss"]),
+                                   rtol=1e-4)
+    flat_ref = {str(p): v for p, v in
+                jax.tree_util.tree_leaves_with_path(
+                    jax.device_get(state_ref.params))}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(state_pp.params)):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_ref[str(path)]),
+            rtol=5e-4, atol=5e-5, err_msg=str(path),
+        )
+
+
+def test_pp_ep_validations(devices8):
+    cfg = tiny_config(num_layers=4, n_experts=2, moe_every=1,
+                      expert_axis="seq", ep_size=2)
+    tx = sgd_with_weight_decay(0.1)
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=1,
+                     model_parallel=N_STAGES)
+    state = create_pp_lm_state(cfg, N_STAGES, tx, jax.random.key(0),
+                               init_len=32)
+    _, specs = shard_pp_state(mesh, state)
+    with pytest.raises(ValueError, match="expert_axis must be the PP data"):
+        make_pp_lm_train_step(mesh, cfg, specs)
+    cfg_bad = tiny_config(num_layers=4, n_experts=4, moe_every=1,
+                          expert_axis="data", ep_size=4)
+    with pytest.raises(ValueError, match="ep_size 4 must equal"):
+        make_pp_lm_train_step(mesh, cfg_bad, specs)
+
+
+def test_pp_tp_ep_matches_reference(devices8):
+    """The full composed cell — TP inside experts, EP over data, stages
+    over the stage axis — against the sequential dense-placement
+    reference. Covers the combined-rules spec path (w_up spec names
+    stage, data, AND model axes) with real parity, not just a finite-loss
+    smoke."""
+    import dataclasses
+
+    cfg = tiny_config(num_layers=4, n_experts=2, moe_every=1,
+                      capacity_factor=float(2 * 8), moe_aux_weight=0.0,
+                      expert_axis="data", ep_size=2,
+                      model_axis="model", tp_size=2)
+    cfg_ref = dataclasses.replace(cfg, expert_axis=None, ep_size=1,
+                                  model_axis=None, tp_size=1)
+    tx = sgd_with_weight_decay(0.1, momentum=0.9)
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=2,
+                     model_parallel=2,
+                     axis_names=("data", "stage", "model"))
+    n_stages = 2
+    state0 = create_pp_lm_state(cfg, n_stages, tx, jax.random.key(4),
+                                init_len=32)
+    state_ref = create_pp_lm_state(cfg_ref, n_stages, tx, jax.random.key(4),
+                                   init_len=32)
+    state_pp, specs = shard_pp_state(mesh, state0, axis="stage", config=cfg)
+    # the combined placement: stack on stage, experts on data, hidden on model
+    for lname in ("layer0", "layer1"):
+        w_up_spec = specs.params["stages"][lname]["moe"]["w_up"]
+        assert w_up_spec == P("stage", "data", None, "model"), (lname,
+                                                                w_up_spec)
+    step_pp = make_pp_lm_train_step(mesh, cfg, specs, n_microbatches=2,
+                                    axis="stage")
+    step_ref = make_pp_reference_step(cfg_ref, n_stages, tx, n_microbatches=2)
+    sh = NamedSharding(mesh, P("data"))
+    for i in range(3):
+        b = batch_np(seed=40 + i)
+        state_pp, m_pp = step_pp(
+            state_pp, {k: jax.device_put(v, sh) for k, v in b.items()}
+        )
+        state_ref, m_ref = step_ref(state_ref, b)
+        np.testing.assert_allclose(float(m_pp["loss"]), float(m_ref["loss"]),
+                                   rtol=2e-4)
+    flat_ref = {str(p): v for p, v in
+                jax.tree_util.tree_leaves_with_path(
+                    jax.device_get(state_ref.params))}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(state_pp.params)):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_ref[str(path)]),
+            rtol=2e-3, atol=2e-4, err_msg=str(path),
+        )
+
+
+def test_pp_ep_specs_without_config_rejected(devices8):
+    """shard_pp_state without config= builds replicated expert specs; the
+    step must name the mistake instead of failing deep in flax."""
+    cfg = tiny_config(num_layers=4, n_experts=2, moe_every=1,
+                      expert_axis="data", ep_size=2)
+    tx = sgd_with_weight_decay(0.1)
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=1,
+                     model_parallel=N_STAGES)
+    state = create_pp_lm_state(cfg, N_STAGES, tx, jax.random.key(0),
+                               init_len=16)
+    _, specs = shard_pp_state(mesh, state)  # config forgotten
+    with pytest.raises(ValueError, match="EP placement rules"):
+        make_pp_lm_train_step(mesh, cfg, specs)
