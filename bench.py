@@ -66,7 +66,7 @@ def measure_roundtrip_s(n: int = 3) -> float:
 
 
 def build(batch_size: int, tiny: bool, dtype=jnp.bfloat16, mesh=None,
-          fused: bool = False):
+          fused: bool = False, int8_trunk: bool = False):
     """State/step/batch for a bench run. ``batch_size`` is the GLOBAL batch
     (sharded over the mesh's data axis; a 1-device mesh makes it per-chip).
     ``mesh`` defaults to one device; scripts/bench_table.py passes multi-
@@ -87,7 +87,8 @@ def build(batch_size: int, tiny: bool, dtype=jnp.bfloat16, mesh=None,
         model = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=100,
                        num_filters=8, dtype=dtype)
     else:
-        model = resnet50(dtype=dtype, fused_bottleneck=fused)
+        model = resnet50(dtype=dtype, fused_bottleneck=fused,
+                         int8_trunk=int8_trunk)
 
     if mesh is None:
         mesh = single_device_mesh()
@@ -113,10 +114,11 @@ def build(batch_size: int, tiny: bool, dtype=jnp.bfloat16, mesh=None,
 
 def run(batch_size: int, tiny: bool, dtype=jnp.bfloat16, warmup: int = 8,
         iters: int = 30, measure_duty: bool = True, mesh=None,
-        fused: bool = False):
+        fused: bool = False, int8_trunk: bool = False):
     from pytorch_distributed_tpu.utils.profiling import device_duty_cycle
 
-    state, step, batch = build(batch_size, tiny, dtype, mesh=mesh, fused=fused)
+    state, step, batch = build(batch_size, tiny, dtype, mesh=mesh, fused=fused,
+                               int8_trunk=int8_trunk)
     for _ in range(warmup):
         state, metrics = step(state, batch)
     # Sync by fetching a value: through tunneled TPU runtimes,

@@ -491,6 +491,44 @@ class FusedBottleneckBlock(nn.Module):
         )
 
 
+@jax.custom_vjp
+def _ste_quant_dequant(x, scale):
+    """int8 round-trip with a straight-through gradient. The value
+    semantics are the quantized ones (NON-parity with the plain model —
+    opt-in only); the grad passes through unchanged (STE), so training
+    proceeds at full-precision gradient fidelity."""
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    # materialize the int8 tensor: without the barrier XLA is free to keep
+    # the wide dtype live between block fusions and the experiment
+    # measures nothing
+    q = jax.lax.optimization_barrier(q)
+    return (q.astype(x.dtype) * scale).astype(x.dtype)
+
+
+def _ste_fwd(x, scale):
+    return _ste_quant_dequant(x, scale), None
+
+
+def _ste_bwd(_, g):
+    return g, None
+
+
+_ste_quant_dequant.defvjp(_ste_fwd, _ste_bwd)
+
+
+def _int8_trunk(x):
+    """Store the residual trunk int8 between blocks (HBM-traffic
+    experiment, PERF_NOTES §7): per-channel abs-max symmetric scale, STE
+    backward. The quantize rides the producing block's epilogue, the
+    dequant fuses into both consumers (next conv + residual add) — the
+    tensor materialized between fusions is the int8 one."""
+    scale = (
+        jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(0, 1, 2),
+                keepdims=True) / 127.0 + 1e-12
+    ).astype(x.dtype)
+    return _ste_quant_dequant(x, scale)
+
+
 class SpaceToDepthStem(nn.Module):
     """The 7×7/2 ImageNet stem computed on a space-to-depth input.
 
@@ -574,6 +612,12 @@ class ResNet(nn.Module):
     remat_blocks: bool = False
     space_to_depth_stem: bool = False
     fused_bottleneck: bool = False
+    # EXPERIMENT (PERF_NOTES §7), opt-in and NON-parity: store the
+    # residual trunk int8 between blocks (per-channel abs-max scale,
+    # straight-through grads). Halves the bytes of the widest stored
+    # activations vs bf16 — the storage-level lever the r3 roofline
+    # analysis named.
+    int8_trunk: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -639,6 +683,8 @@ class ResNet(nn.Module):
                         pointwise=pointwise,
                         name=f"stage{i + 1}_block{j + 1}",
                     )(x)
+                if self.int8_trunk:
+                    x = _int8_trunk(x)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)

@@ -44,6 +44,14 @@ class TransformerConfig:
     attention: str = "dense"  # dense | blockwise | flash | ring | ring_flash
     block_size: int = 512  # kv block for blockwise attention
     seq_axis: str = SEQ_AXIS  # mesh axis for attention="ring"
+    # Ring shard layout: "contiguous" (shard i = tokens [i*L, (i+1)*L)) or
+    # "zigzag" (shard i = chunks (i, 2s-1-i) — balances the causal ring's
+    # critical path, halving the max per-rank block area at sp=8;
+    # ops/ring_flash.py). Zigzag batches must be host-permuted with
+    # parallel.sequence.zigzag_shard (train.lm_trainer.shard_lm_batch does
+    # it from this flag) and wpe positions follow the chunk map (the LM
+    # steps pass a position VECTOR).
+    ring_layout: str = "contiguous"
     # Megatron-style tensor parallelism: set model_axis to the mesh's model
     # axis name and tp_size to its size when running under shard_map with
     # params sharded by ``train.lm.TRANSFORMER_TP_RULES``. Parameters keep
@@ -66,6 +74,19 @@ class TransformerConfig:
     ep_size: int = 1
 
     def __post_init__(self):
+        if self.ring_layout not in ("contiguous", "zigzag"):
+            raise ValueError(
+                f"ring_layout {self.ring_layout!r} must be 'contiguous' or "
+                "'zigzag'"
+            )
+        if self.ring_layout == "zigzag" and self.attention not in (
+            "ring", "ring_flash"
+        ):
+            raise ValueError(
+                f"ring_layout='zigzag' only applies to ring attention "
+                f"(got attention={self.attention!r}); the layout is a "
+                "causal-ring scheduling balance, meaningless elsewhere"
+            )
         if self.n_experts and self.n_experts % self.ep_size:
             raise ValueError(
                 f"n_experts {self.n_experts} not divisible by ep_size {self.ep_size}"
@@ -172,13 +193,23 @@ class Attention(nn.Module):
         if cfg.attention == "ring":
             from pytorch_distributed_tpu.parallel.sequence import ring_attention
 
-            # The kernel derives each shard's position as base + index*L;
-            # recover the document base from the caller's absolute offset so
-            # any position_offset convention stays consistent with the mask.
-            base = position_offset - jax.lax.axis_index(cfg.seq_axis) * l
-            out = ring_attention(
-                q, k, v, axis=cfg.seq_axis, causal=True, base_offset=base
-            )
+            if cfg.ring_layout == "zigzag":
+                # zigzag derives chunk positions from the ring index with
+                # a document-rooted convention; the trainer feeds wpe a
+                # matching position VECTOR (train/lm.py) and batches are
+                # host-permuted, so base is 0 here.
+                out = ring_attention(
+                    q, k, v, axis=cfg.seq_axis, causal=True, layout="zigzag"
+                )
+            else:
+                # The kernel derives each shard's position as
+                # base + index*L; recover the document base from the
+                # caller's absolute offset so any position_offset
+                # convention stays consistent with the mask.
+                base = position_offset - jax.lax.axis_index(cfg.seq_axis) * l
+                out = ring_attention(
+                    q, k, v, axis=cfg.seq_axis, causal=True, base_offset=base
+                )
         elif cfg.attention == "ring_flash":
             from pytorch_distributed_tpu.ops.ring_flash import (
                 ring_flash_attention,
@@ -187,28 +218,31 @@ class Attention(nn.Module):
             # Same ring schedule, Pallas flash kernels per visiting shard
             # (ops/ring_flash.py). Causal structure comes from ring
             # positions, which is exact for any uniform position offset.
-            # Blocks must DIVIDE the shard length (no pad path under the
-            # ring) and should stay lane-aligned: prefer the largest
+            # Blocks must DIVIDE the kernel's working length — the shard
+            # under the contiguous layout, a HALF-shard chunk under zigzag
+            # — and should stay lane-aligned: prefer the largest
             # 128-multiple divisor within block_size; small shards run as
             # one block; anything else (e.g. L_local=250) is rejected
             # rather than silently degenerating to tiny unaligned blocks.
-            limit = min(cfg.block_size, l)
+            zig = cfg.ring_layout == "zigzag"
+            lw = l // 2 if zig else l
+            limit = min(cfg.block_size, lw)
             blk = max(
-                (c for c in range(128, limit + 1, 128) if l % c == 0),
+                (c for c in range(128, limit + 1, 128) if lw % c == 0),
                 default=None,
             )
-            if blk is None and l <= limit and (l < 128 or l % 8 == 0):
-                blk = l  # single-block shard (small/test shapes)
+            if blk is None and lw <= limit and (lw < 128 or lw % 8 == 0):
+                blk = lw  # single-block shard (small/test shapes)
             if blk is None:
                 raise ValueError(
-                    f"ring_flash: no usable block size for shard length {l} "
-                    f"(block_size {cfg.block_size}); pad the sequence so "
-                    "L/seq_parallel has a 128-multiple divisor, or use "
+                    f"ring_flash: no usable block size for working length "
+                    f"{lw} (block_size {cfg.block_size}); pad the sequence "
+                    "so it has a 128-multiple divisor, or use "
                     "attention='ring'"
                 )
             out = ring_flash_attention(
                 q, k, v, axis=cfg.seq_axis, causal=True,
-                block_q=blk, block_k=blk,
+                block_q=blk, block_k=blk, layout=cfg.ring_layout,
             )
         elif cfg.attention == "blockwise":
             out = blockwise_attention(
@@ -314,7 +348,7 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, position_offset: jax.Array | int = 0,
                  train: bool = True, decode: bool = False,
-                 prefill: bool = False):
+                 prefill: bool = False, positions: jax.Array | None = None):
         cfg = self.config
         # Dropout is active only when train=True AND an rng is provided
         # (apply(..., rngs={"dropout": key}) — train/lm.py derives the key
@@ -322,7 +356,23 @@ class TransformerLM(nn.Module):
         inference = decode or prefill
         deterministic = not (train and cfg.dropout > 0.0) or inference
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype, name="wte")(tokens)
-        pos = position_offset + jnp.arange(tokens.shape[1])
+        # ``positions`` ([L_local] i32) overrides the contiguous
+        # offset+arange convention — required for the zigzag ring layout,
+        # whose shards hold non-contiguous chunk pairs (train/lm.py
+        # computes the chunk-map vector). Refuse silently-wrong math: a
+        # zigzag config with no position vector would embed contiguous
+        # wpe positions for non-contiguous tokens.
+        if cfg.ring_layout == "zigzag" and positions is None:
+            raise ValueError(
+                "ring_layout='zigzag' requires the per-shard position "
+                "vector (positions=): shards hold chunk pairs "
+                "(r, 2s-1-r), so offset+arange wpe positions are wrong. "
+                "Use the LM train/eval steps (train/lm.py), which compute "
+                "it, and shard batches with shard_lm_batch(..., "
+                "layout='zigzag')."
+            )
+        pos = (positions if positions is not None
+               else position_offset + jnp.arange(tokens.shape[1]))
         x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype, name="wpe")(pos)
         if cfg.dropout and not inference:
             x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)

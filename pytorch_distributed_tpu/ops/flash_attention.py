@@ -291,51 +291,60 @@ def compute_delta(do3, o3):
     return jnp.broadcast_to(delta[:, :, None], (bh, lq, 128))
 
 
-def _flash_bwd(q3, k3, v3, o3, lse3, do3, scale, causal, block_q, block_k,
-               kv_len, interpret, delta3=None):
+def _flash_bwd(q3, k3, v3, o3, lse3, do3, scale, causal, dq_blocks,
+               dkv_blocks, kv_len, interpret, delta3=None):
+    """Backward kernels with INDEPENDENTLY SPECIFIABLE tilings:
+    ``dq_blocks`` / ``dkv_blocks`` are (block_q, block_k) for the dQ and
+    dK/dV kernels. NOTE: isolated per-kernel sweeps suggested mixed
+    tilings, but those do NOT compose — the composed A/B through the
+    real vjp measured the 'per-kernel-optimal' mix 26% WORSE
+    (BENCH_ATTENTION.md r4); ``flash_attention`` therefore passes the
+    SAME tuple to both, length-selected. The two parameters exist for
+    sweeps, not because mixed defaults won."""
     bh, lq, d = q3.shape
     lk = k3.shape[1]
     if delta3 is None:
         delta3 = compute_delta(do3, o3)
-
-    common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, kv_len=kv_len)
-    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    row_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
-    kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         )
 
+    bq, bk = dq_blocks
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0))
+    kv_spec_q = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
     dq3 = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, kv_len=kv_len),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
-        grid=(bh, lq // block_q, lk // block_k),
+        grid=(bh, lq // bq, lk // bk),
         in_specs=[q_spec, kv_spec_q, kv_spec_q, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
         **kwargs,
     )(q3, k3, v3, do3, lse3, delta3)
 
     # dK/dV: grid puts the KV block second, Q innermost (the recurrence).
-    q_spec_i = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    row_spec_i = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    bq, bk = dkv_blocks
+    q_spec_i = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    row_spec_i = pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
     dk3, dv3 = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, kv_len=kv_len),
         out_shape=[
             jax.ShapeDtypeStruct((bh, lk, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, lk, d), v3.dtype),
         ],
-        grid=(bh, lk // block_k, lq // block_q),
+        grid=(bh, lk // bk, lq // bq),
         in_specs=[q_spec_i, kv_spec, kv_spec, q_spec_i, row_spec_i, row_spec_i],
         out_specs=[kv_spec, kv_spec],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
         **kwargs,
@@ -343,10 +352,12 @@ def _flash_bwd(q3, k3, v3, o3, lse3, do3, scale, causal, block_q, block_k,
     return dq3, dk3, dv3
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, block_q, block_k, kv_len, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, scale, causal, block_q, block_k, kv_len, interpret,
+           dq_blocks=None, dkv_blocks=None):
     out, _ = _flash_vjp_fwd(
-        q, k, v, scale, causal, block_q, block_k, kv_len, interpret
+        q, k, v, scale, causal, block_q, block_k, kv_len, interpret,
+        dq_blocks, dkv_blocks,
     )
     return out
 
@@ -362,7 +373,7 @@ def _from3(x3, b, h):
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, kv_len,
-                   interpret):
+                   interpret, dq_blocks=None, dkv_blocks=None):
     b, lq, h, d = q.shape
     o3, lse3 = _flash_fwd(
         _to3(q), _to3(k), _to3(v), scale, causal, block_q, block_k, kv_len,
@@ -371,12 +382,18 @@ def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, kv_len,
     return _from3(o3, b, h), (q, k, v, o3, lse3)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, kv_len, interpret, res, g):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, kv_len, interpret,
+                   dq_blocks, dkv_blocks, res, g):
     q, k, v, o3, lse3 = res
     b, lq, h, d = q.shape
+    # The backward tiles independently of the forward; flash_attention
+    # computes the tuples (None only through direct _flash calls —
+    # fall back to the forward tiling).
+    dq_blocks = dq_blocks or (block_q, block_k)
+    dkv_blocks = dkv_blocks or (block_q, block_k)
     dq3, dk3, dv3 = _flash_bwd(
         _to3(q), _to3(k), _to3(v), o3, lse3, _to3(g.astype(q.dtype)),
-        scale, causal, block_q, block_k, kv_len, interpret,
+        scale, causal, dq_blocks, dkv_blocks, kv_len, interpret,
     )
     return _from3(dq3, b, h), _from3(dk3, b, h), _from3(dv3, b, h)
 
@@ -393,6 +410,8 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 1024,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """FlashAttention: ``softmax(QKᵀ·scale)V`` tiled through VMEM.
@@ -401,6 +420,14 @@ def flash_attention(
       q, k, v: ``[B, L, H, D]``; any lengths — inputs are zero-padded to
         block multiples and padded key positions are masked in-kernel
         (round 1 required exact multiples).
+      bwd_block_q/bwd_block_k: ONE tiling for both backward kernels
+        (sweep/debug override). When left None, the backward auto-tiles
+        by length from the r4 composed sweep: (1024, 1024) for both
+        kernels at padded L >= 4096 (89.8 / 99.1 TFLOP/s fwdbwd at
+        4096/8192 vs 89.1 / 97.2 at the forward's (512, 1024)); below
+        that the r3-tuned shared default stands. Isolated per-kernel
+        sweeps suggested MIXED tilings — measured 26% WORSE composed;
+        see BENCH_ATTENTION.md round-4.
       interpret: run the kernels in the Pallas interpreter (CPU testing).
 
     Default block sizes come from an on-chip sweep (v5e, causal, D=128,
@@ -419,14 +446,54 @@ def flash_attention(
     lq, lk = q.shape[1], k.shape[1]
     block_q = min(block_q, max(lq, 1))
     block_k = min(block_k, max(lk, 1))
-    pad_q = (-lq) % block_q
-    pad_k = (-lk) % block_k
+    # padded lengths must be multiples of BOTH the fwd and bwd tilings
+    # (the bwd kernels read the same padded residuals); with power-of-two
+    # blocks the max is the lcm. Explicit bwd overrides are NOT clamped to
+    # the raw length — padding rounds up to cover them.
+    bq_c = bwd_block_q or block_q
+    bk_c = bwd_block_k or block_k
+    pq_mult = max(block_q, bq_c)
+    pk_mult = max(block_k, bk_c)
+    if pq_mult % min(block_q, bq_c) or pk_mult % min(block_k, bk_c):
+        raise ValueError(
+            f"bwd blocks ({bwd_block_q}, {bwd_block_k}) and fwd blocks "
+            f"({block_q}, {block_k}) must divide each other pairwise "
+            "(shared zero-padding)"
+        )
+    pad_q = (-lq) % pq_mult
+    pad_k = (-lk) % pk_mult
+    lq_pad, lk_pad = lq + pad_q, lk + pad_k
+
+    def _fit(cand: int, n: int) -> int:
+        # largest block <= cand that divides the padded length (blocks
+        # and padded lengths are powers-of-two multiples of each other)
+        b = min(cand, n)
+        while n % b:
+            b //= 2
+        return max(b, 1)
+
+    if bwd_block_q or bwd_block_k:
+        dq_blocks = dkv_blocks = (min(bq_c, lq_pad), min(bk_c, lk_pad))
+    elif lk_pad >= 4096:
+        # r4 sweep THROUGH the real vjp: (1024, 1024) for both backward
+        # kernels is the (marginal) winner at L in {4096, 8192} — 89.8 /
+        # 99.1 TFLOP/s fwdbwd vs 89.1 / 97.2 at the shared (512, 1024).
+        # NOTE the per-kernel standalone sweep suggested mixed tilings
+        # (dKV (512, 2048) "1.77x faster") that do NOT compose end-to-end
+        # — (512,1024)/(512,2048) measured 65.5 TFLOP/s, far WORSE;
+        # standalone pallas_call timings mislead about the composed
+        # pipeline. Composed measurements only.
+        dq_blocks = dkv_blocks = (_fit(1024, lq_pad), _fit(1024, lk_pad))
+    else:
+        dq_blocks = dkv_blocks = (block_q, block_k)
+
     if pad_q or pad_k:
         padq = lambda x: jnp.pad(x, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
         padk = lambda x: jnp.pad(x, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
         out = _flash(
             padq(q), padk(k), padk(v), scale, causal, block_q, block_k, lk,
-            interpret,
+            interpret, dq_blocks, dkv_blocks,
         )
         return out[:, :lq]
-    return _flash(q, k, v, scale, causal, block_q, block_k, lk, interpret)
+    return _flash(q, k, v, scale, causal, block_q, block_k, lk, interpret,
+                  dq_blocks, dkv_blocks)

@@ -184,7 +184,8 @@ def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret, layout,
     def shard_bwd(k_cur, v_cur, causal_block):
         return _flash_bwd(
             q3, k_cur, v_cur, o3, lse3, do3, scale, causal_block,
-            block_q, block_k, k_cur.shape[1], interpret, delta3=delta3,
+            (block_q, block_k), (block_q, block_k), k_cur.shape[1],
+            interpret, delta3=delta3,
         )
 
     def fold(dq_acc, dk_cur, dv_cur, k_cur, v_cur, step):
@@ -364,7 +365,8 @@ def _ring_flash_zigzag_bwd(axis, scale, block_q, block_k, interpret, res, g):
         qc, oc, lsec, doc, dc = chunks[which]
         return _flash_bwd(
             qc, kc, vc, oc, lsec, doc, scale, causal_block,
-            block_q, block_k, kc.shape[1], interpret, delta3=dc,
+            (block_q, block_k), (block_q, block_k), kc.shape[1],
+            interpret, delta3=dc,
         )
 
     def fold(dq_acc, dkv_cur, k_cur, v_cur, step):

@@ -76,7 +76,7 @@ def create_lm_state(
     # either way, so the produced tree serves every parallel layout.
     dense_cfg = dataclasses.replace(
         config, attention="dense", model_axis=None, tp_size=1,
-        expert_axis=None, ep_size=1,
+        expert_axis=None, ep_size=1, ring_layout="contiguous",
     )
     init_model = TransformerLM(dense_cfg)
     state = TrainState.create(
@@ -199,6 +199,29 @@ def shard_lm_state(
     return jax.device_put(state, specs_to_shardings(mesh, specs)), specs
 
 
+def _shard_positions(config, lq: int, seq_axis: str):
+    """This shard's ABSOLUTE token positions: ``(positions, offset)``.
+
+    Contiguous layout: ``positions=None`` and the scalar shard offset (the
+    convention every attention path accepts). Zigzag: a [lq] position
+    VECTOR following the chunk-pair map (shard r holds chunks
+    (r, 2s-1-r) of the 2s-chunk decomposition) and offset 0 — wpe must
+    embed the true absolute positions even though the shard's tokens are
+    not contiguous."""
+    if (
+        config is not None
+        and getattr(config, "ring_layout", "contiguous") == "zigzag"
+    ):
+        c = lq // 2
+        r = jax.lax.axis_index(seq_axis)
+        s = jax.lax.psum(1, seq_axis)
+        positions = jnp.concatenate([
+            r * c + jnp.arange(c), (2 * s - 1 - r) * c + jnp.arange(c)
+        ])
+        return positions, 0
+    return None, jax.lax.axis_index(seq_axis) * lq
+
+
 def check_seq_parallel_attention(mesh: Mesh, config, seq_axis: str = SEQ_AXIS):
     """Refuse silently-wrong sequence parallelism.
 
@@ -257,7 +280,7 @@ def make_lm_train_step(
 
     def _local_step(state: TrainState, batch: dict):
         lq = batch["tokens"].shape[1]
-        offset = jax.lax.axis_index(seq_axis) * lq
+        positions, offset = _shard_positions(config, lq, seq_axis)
         # Token count is param-independent, so its psum can live outside the
         # differentiated function. No param-dependent psum may sit inside
         # loss_fn: under shard_map a psum transposes to another psum, which
@@ -284,6 +307,7 @@ def make_lm_train_step(
                 {"params": params},
                 batch["tokens"],
                 position_offset=offset,
+                positions=positions,
                 mutable=["aux_loss", "moe_stats"],
                 rngs=rngs,
             )
@@ -405,12 +429,13 @@ def make_lm_eval_step(
 
     def _local_eval(state: TrainState, batch: dict, acc: dict):
         lq = batch["tokens"].shape[1]
-        offset = jax.lax.axis_index(seq_axis) * lq
+        positions, offset = _shard_positions(config, lq, seq_axis)
         apply_fn = eval_apply if eval_apply is not None else state.apply_fn
         logits = apply_fn(
             {"params": state.params},
             batch["tokens"],
             position_offset=offset,
+            positions=positions,
             train=False,
         )
         per_tok = cross_entropy_loss(

@@ -60,9 +60,22 @@ def lm_collate(samples) -> dict:
 
 
 def shard_lm_batch(mesh, batch, data_axis=mesh_lib.DATA_AXIS,
-                   seq_axis=mesh_lib.SEQ_AXIS):
-    """Host-local [B, L] arrays → global arrays sharded P(data, seq)."""
+                   seq_axis=mesh_lib.SEQ_AXIS, layout="contiguous"):
+    """Host-local [B, L] arrays → global arrays sharded P(data, seq).
+
+    ``layout="zigzag"``: every per-token array is host-permuted with
+    ``parallel.sequence.zigzag_shard`` first, so the contiguous placement
+    delivers chunk pair (r, 2s-1-r) to seq-shard r — tokens, labels, and
+    weights permute identically and stay aligned; the LM steps feed wpe
+    the matching position vector (train/lm.py ``_shard_positions``)."""
     sharding = NamedSharding(mesh, P(data_axis, seq_axis))
+    s = mesh.shape[seq_axis]
+    if layout == "zigzag" and s > 1:
+        from pytorch_distributed_tpu.parallel.sequence import zigzag_shard
+
+        batch = jax.tree.map(
+            lambda x: zigzag_shard(np.asarray(x), s, axis=1), batch
+        )
     return jax.tree.map(
         lambda x: jax.make_array_from_process_local_data(
             sharding, np.asarray(x)
@@ -189,7 +202,10 @@ class LMTrainer(SuspendableTrainer):
         for step, host_batch in enumerate(
             self.train_loader.iter_batches(start_step), start=start_step
         ):
-            batch = shard_lm_batch(self.mesh, host_batch)
+            batch = shard_lm_batch(
+                self.mesh, host_batch,
+                layout=self.model_config.ring_layout,
+            )
             self.state, metrics = self.train_step(self.state, batch)
             steps_done += 1
             if cfg.log_every and step % cfg.log_every == 0:
@@ -241,7 +257,10 @@ class LMTrainer(SuspendableTrainer):
                     for k, v in host_batch.items()
                 }
             acc = self.eval_step(
-                self.state, shard_lm_batch(self.mesh, host_batch), acc
+                self.state,
+                shard_lm_batch(self.mesh, host_batch,
+                               layout=self.model_config.ring_layout),
+                acc
             )
         acc = jax.device_get(acc)
         tokens = float(acc["tokens"])

@@ -189,6 +189,11 @@ def parse_lm_args(description: str) -> argparse.Namespace:
                         "ring_flash; pass ring for the XLA ring)")
     p.add_argument("--seq-parallel", type=int, default=2,
                    help="sequence-parallel degree (ring attention when > 1)")
+    p.add_argument("--ring-layout", default="contiguous",
+                   choices=["contiguous", "zigzag"],
+                   help="causal-ring shard layout; zigzag balances the "
+                        "causal critical path across seq shards "
+                        "(parallel/sequence.py)")
     p.add_argument("--model-parallel", type=int, default=1,
                    help="tensor-parallel degree")
     return p.parse_args()

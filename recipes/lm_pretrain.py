@@ -99,6 +99,7 @@ def main() -> None:
             model_axis="model" if tp > 1 else None,
             tp_size=tp,
             dropout=args.dropout,
+            ring_layout=args.ring_layout if sp > 1 else "contiguous",
         )
     else:
         model_cfg = TransformerConfig(
@@ -112,6 +113,7 @@ def main() -> None:
             attention=attention,
             model_axis="model" if tp > 1 else None,
             tp_size=tp,
+            ring_layout=args.ring_layout if sp > 1 else "contiguous",
         )
 
     cfg = LMTrainerConfig(

@@ -152,7 +152,39 @@ def parity_on_device(b=2, h=4, l=512, d=64):
     assert bwd_err < 1e-2 * max(scale_ref, 1.0), (bwd_err, scale_ref)
 
 
+def sweep_bwd():
+    """Round-4 sweep (VERDICT r3 weak #3): the backward kernels' tiling at
+    L >= 4096, independent of the forward's (512, 1024). fwdbwd numbers
+    include the fixed fwd kernel, so compare rows, not absolutes."""
+    b, h, d = 2, 4, 128
+    for l in (4096, 8192):
+        rows = []
+        for bq in (256, 512, 1024):
+            for bk in (512, 1024, 2048):
+                fn = functools.partial(
+                    flash_attention, causal=True,
+                    bwd_block_q=bq, bwd_block_k=bk,
+                )
+                try:
+                    dt, tf = bench_impl(
+                        f"flash_bwd[{bq},{bk}]", fn, b, h, l, d, True,
+                        "fwdbwd",
+                    )
+                    rows.append((tf, bq, bk))
+                except Exception as e:
+                    print(json.dumps({"impl": f"flash_bwd[{bq},{bk}]",
+                                      "L": l, "error": str(e)[:120]}))
+        if rows:
+            tf, bq, bk = max(rows)
+            print(json.dumps({"sweep_bwd_best": {"L": l, "bwd_block_q": bq,
+                                                 "bwd_block_k": bk,
+                                                 "tflops": tf}}))
+
+
 def main():
+    if "--sweep-bwd" in sys.argv:
+        sweep_bwd()
+        return
     quick = "--quick" in sys.argv
     parity_on_device()
     b, h, d = (2, 4, 128)

@@ -1,0 +1,143 @@
+"""ResNet wall quantification + the int8-trunk storage experiment
+(VERDICT r3 next #3).
+
+Three measurements on the real chip, one JSON line each:
+
+1. ``hbm_ceiling_gb_s`` — MEASURED streaming bandwidth: a triad-style
+   ``y = x * a + b`` over a 1 GiB bf16 array (2 bytes moved per stored
+   byte: one read + one write), timed by the chained-slope method. This
+   replaces the datasheet 819 GB/s / estimated ~690 GB/s numbers with
+   what THIS chip actually streams.
+2. ``resnet_achieved_gb_s`` — the bf16 bs128 fused train step's analytic
+   minimum HBM traffic divided by its measured step time. The byte count
+   enumerates the tensors the compiled program MUST materialize
+   (per-conv inputs/outputs fwd, their re-reads + grad writes bwd,
+   params+grads+momentum), assuming perfect elementwise/BN fusion into
+   conv epilogues — i.e. it UNDERCOUNTS real traffic, so the reported
+   roofline fraction is a LOWER bound.
+3. ``int8_trunk_img_s`` — one storage-level lever, measured: residual
+   trunk stored int8 between blocks (models/resnet.py ``int8_trunk``,
+   STE grads, opt-in/non-parity). Reported win or lose.
+
+Usage: python scripts/exp_resnet_roofline.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def measure_hbm_ceiling() -> float:
+    """Streaming GB/s of y = x*a+b over 512M bf16 elements (1 GiB)."""
+    from bench_attention import difftime
+
+    n = 512 * 1024 * 1024
+    x = jnp.ones((n,), jnp.bfloat16)
+
+    @jax.jit
+    def chained(k):
+        def body(i, carry):
+            return carry * jnp.bfloat16(0.999) + jnp.bfloat16(1e-6)
+
+        y = lax.fori_loop(0, k, body, x)
+        return jnp.sum(y[:1].astype(jnp.float32))
+
+    dt = difftime(chained, k1=5, k2=55)  # seconds per iteration
+    bytes_moved = 2 * n * 2  # read + write, 2 B/elt
+    return bytes_moved / dt / 1e9
+
+
+def resnet50_min_traffic_bytes(bs: int = 128) -> int:
+    """Analytic minimum HBM bytes of one fused-bottleneck bf16 train step.
+
+    Counts, in bf16 (2 B) unless noted:
+    - forward: every conv's input read + output write (convs cannot fuse
+      into each other; BN/relu/residual ride epilogues for free in the
+      fused-block design);
+    - backward: each saved activation read once, each activation grad
+      written+read once along the chain (remat off — the bench config);
+    - params: fp32 read (fwd) + grad write + momentum read/write + param
+      write (SGD, 4 B each).
+    Stats/LSE-style small vectors are ignored (<1% of the total).
+    """
+    # (H, W, C_in, C_out, convs per block): ResNet-50 stages at 224 input
+    stem = (224 * 224 * 3, 112 * 112 * 64)  # 7x7/2 conv in/out elements
+    pool = (112 * 112 * 64, 56 * 56 * 64)
+    stages = [  # (n_blocks, H, W, f, expansion 4)
+        (3, 56, 64), (4, 28, 128), (6, 14, 256), (3, 7, 512),
+    ]
+    elems = stem[0] + stem[1] + pool[0] + pool[1]  # stem + maxpool traffic
+    for n_blocks, hw, f in stages:
+        for b in range(n_blocks):
+            first = b == 0
+            # block input: stage1 block0 reads the 56x56x64 maxpool output
+            # (stride 1); later stages' block0 reads the previous stage's
+            # 2hw x 2hw x 2f output (stride 2); non-first blocks read
+            # hw x hw x 4f.
+            hw_in = hw if (not first or f == 64) else hw * 2
+            cin_real = (4 * f) if not first else (64 if f == 64 else 2 * f)
+            # conv1 1x1: [hw_in^2, cin] -> [hw_in^2, f]
+            # conv2 3x3/s: -> [hw^2, f]; conv3 1x1: -> [hw^2, 4f]
+            # downsample (first block): block input -> [hw^2, 4f]
+            c1_in = hw_in * hw_in * cin_real
+            c1_out = hw_in * hw_in * f
+            c2_out = hw * hw * f
+            c3_out = hw * hw * 4 * f
+            fwd = c1_in + c1_out + (c1_out + c2_out) + (c2_out + c3_out)
+            if first:
+                fwd += c1_in + c3_out  # downsample read + write
+            # bwd: read saved (c1_in, c1_out, c2_out) + grad chain
+            # write+read per conv boundary + residual grad
+            bwd = (c1_in + c1_out + c2_out) + 2 * (c1_out + c2_out + c3_out)
+            if first:
+                bwd += c1_in + c3_out
+            elems += fwd + bwd
+    act_bytes = elems * bs * 2  # bf16
+    params = 25_557_032
+    param_bytes = params * 4 * 5  # read + grad w + mom r/w + param w, fp32
+    return act_bytes + param_bytes
+
+
+def main() -> None:
+    import bench
+
+    ceiling = measure_hbm_ceiling()
+    print(json.dumps({"hbm_ceiling_gb_s": round(ceiling, 1),
+                      "method": "bf16 triad 1GiB, chained-slope"}))
+
+    bs = int(os.environ.get("BENCH_BS", "128"))
+    img_s, step_s, _ = bench.run(bs, tiny=False, fused=True,
+                                 measure_duty=False)
+    traffic = resnet50_min_traffic_bytes(bs)
+    achieved = traffic / step_s / 1e9
+    print(json.dumps({
+        "resnet_bf16_img_s": round(img_s, 1),
+        "step_ms": round(step_s * 1e3, 2),
+        "analytic_min_traffic_gb": round(traffic / 1e9, 2),
+        "resnet_achieved_gb_s": round(achieved, 1),
+        "roofline_fraction_lower_bound": round(achieved / ceiling, 3),
+    }))
+
+    img_s8, step_s8, _ = bench.run(bs, tiny=False, fused=True,
+                                   int8_trunk=True, measure_duty=False)
+    print(json.dumps({
+        "int8_trunk_img_s": round(img_s8, 1),
+        "int8_trunk_step_ms": round(step_s8 * 1e3, 2),
+        "int8_trunk_speedup": round(img_s8 / img_s, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
