@@ -448,10 +448,14 @@ def flash_attention(
     block_k = min(block_k, max(lk, 1))
     # padded lengths must be multiples of BOTH the fwd and bwd tilings
     # (the bwd kernels read the same padded residuals); with power-of-two
-    # blocks the max is the lcm. Explicit bwd overrides are NOT clamped to
-    # the raw length — padding rounds up to cover them.
-    bq_c = bwd_block_q or block_q
-    bk_c = bwd_block_k or block_k
+    # blocks the max is the lcm. Explicit bwd overrides are clamped to the
+    # FORWARD-padded length (not the raw one): short sequences then
+    # degrade gracefully like the unswept path, while a larger override
+    # at block-multiple lengths still rounds the padding up to cover it.
+    lq_pad0 = lq + ((-lq) % block_q)
+    lk_pad0 = lk + ((-lk) % block_k)
+    bq_c = min(bwd_block_q, lq_pad0) if bwd_block_q else block_q
+    bk_c = min(bwd_block_k, lk_pad0) if bwd_block_k else block_k
     pq_mult = max(block_q, bq_c)
     pk_mult = max(block_k, bk_c)
     if pq_mult % min(block_q, bq_c) or pk_mult % min(block_k, bk_c):

@@ -1,0 +1,201 @@
+"""Host input-pipeline WORKER scaling + the GIL evidence (VERDICT r3 #7).
+
+The r3 gap: every data-pipeline number was measured with one worker on
+one core, leaving "threads + GIL-releasing decode scale like the
+reference's 8 NUMA processes" as an untested claim. This host has
+exactly ONE physical core (`nproc` = 1), so a worker sweep here CANNOT
+show real multi-core scaling — instead this script measures the two
+things one core CAN prove, and states the limit honestly:
+
+1. **Worker sweep** (JPEG and raw paths, num_workers ∈ {0,1,2,4,8}):
+   on one core the expectation is FLAT throughput with no
+   thread-overhead collapse — threads must not cost, even when they
+   cannot pay. A drop at higher worker counts would be a real queue/
+   lock bottleneck; flat curves mean the machinery adds ~zero overhead.
+2. **GIL-release proof** per pipeline stage: a counter thread spins in
+   pure Python while the stage runs in another thread. A stage that
+   HOLDS the GIL starves the counter to ~0 during its C call; a stage
+   that releases it lets the counter timeshare (~half rate on one
+   core). Measured for PIL JPEG decode, PIL resize, the TPRC C++ batch
+   read, and (as a deliberate negative control) ``ndarray.tolist``,
+   which builds PyObjects under the lock.
+
+Together: the worker machinery is overhead-free and the heavy stages
+(decode, resize, record IO) demonstrably release the GIL — the two
+preconditions for thread scaling on a real multi-core host. The
+remaining per-core number (bench.py: ~9.8k img/s/core raw) says a
+v5e-8 host feed (~24k img/s) needs ~3 cores of an 8-core host.
+
+Usage: python scripts/bench_data_scaling.py [--n 1024]
+Prints one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def synth_jpegs(n: int, size: int = 256):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        base = rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+        img = Image.fromarray(base).resize((size, size), Image.BILINEAR)
+        buf = io.BytesIO()
+        img.save(buf, "JPEG", quality=90)
+        yield buf.getvalue(), i % 1000
+
+
+def build_splits(n: int):
+    from pytorch_distributed_tpu.data.imagenet import write_imagenet_split
+    from pytorch_distributed_tpu.data.raw import write_imagenet_raw_split
+
+    cache = os.path.join(tempfile.gettempdir(), f"pdt_scaling_{n}")
+    jpeg = os.path.join(cache, "train.tprc")
+    raw = os.path.join(cache, "train.rawtprc")
+    if not os.path.exists(jpeg):
+        os.makedirs(cache, exist_ok=True)
+        write_imagenet_split(jpeg, synth_jpegs(n))
+    if not os.path.exists(raw):
+        rng = np.random.default_rng(1)
+        write_imagenet_raw_split(
+            raw,
+            ((rng.integers(0, 255, (256, 256, 3)).astype(np.uint8), i % 1000)
+             for i in range(n)),
+        )
+    return cache
+
+
+def sweep_workers(cache: str) -> None:
+    from pytorch_distributed_tpu.data.imagenet import ImageNet
+    from pytorch_distributed_tpu.data.loader import (
+        DataLoader,
+        measure_throughput,
+    )
+    from pytorch_distributed_tpu.data.raw import RawImageNet
+
+    for mode, ds_fn in (
+        ("jpeg", lambda: ImageNet("train", data_dir=cache)),
+        ("raw", lambda: RawImageNet("train", data_dir=cache, aug="crop")),
+    ):
+        base = None
+        for workers in (0, 1, 2, 4, 8):
+            loader = DataLoader(ds_fn(), batch_size=128,
+                                num_workers=workers, prefetch=4)
+            img_s = measure_throughput(loader, epochs=2)
+            if workers <= 1 and (base is None or img_s > base):
+                base = img_s
+            print(json.dumps({
+                "path": mode, "num_workers": workers,
+                "img_s": round(img_s, 1),
+                "vs_1worker": round(img_s / base, 3) if base else None,
+                "host_cores": os.cpu_count(),
+            }))
+
+
+def gil_release_probe() -> None:
+    """Counter-starvation test: counts/sec of a pure-Python thread while
+    a candidate stage runs. ratio ≈ 0 → stage holds the GIL; ratio
+    clearly > 0.3 → stage releases it (timesharing one core; the
+    GIL-holding control measures ~0.14 — switch-interval leakage)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(2)
+    big = Image.fromarray(
+        rng.integers(0, 255, (64, 64, 3)).astype(np.uint8)
+    ).resize((4096, 4096), Image.BILINEAR)
+    buf = io.BytesIO()
+    big.save(buf, "JPEG", quality=95)
+    jpeg_bytes = buf.getvalue()
+
+    from pytorch_distributed_tpu.data.raw import (
+        RawImageNet,
+        write_imagenet_raw_split,
+    )
+
+    cache = os.path.join(tempfile.gettempdir(), "pdt_gil_probe")
+    raw = os.path.join(cache, "train.rawtprc")
+    if not os.path.exists(raw):
+        os.makedirs(cache, exist_ok=True)
+        write_imagenet_raw_split(
+            raw,
+            ((rng.integers(0, 255, (256, 256, 3)).astype(np.uint8), i)
+             for i in range(512)),
+        )
+    ds = RawImageNet("train", data_dir=cache, aug="crop")
+    reader = ds.reader  # TPRC native batch reader
+
+    small = rng.standard_normal((2048, 2048)).astype(np.float32)
+    stages = {
+        "pil_jpeg_decode": lambda: Image.open(
+            io.BytesIO(jpeg_bytes)).convert("RGB").load(),
+        "pil_resize": lambda: big.resize((2048, 2048), Image.BILINEAR),
+        "tprc_batch_read": lambda: reader.read_batch(list(range(256))),
+        # CONTROL that genuinely HOLDS the GIL: ndarray.tolist builds
+        # millions of PyObjects under the lock (numpy ufuncs like np.exp
+        # RELEASE it, so they are not a valid negative control)
+        "ndarray_tolist_CONTROL": lambda: small.tolist(),
+    }
+
+    def counter_rate(during, runs=5):
+        stop = [False]
+        count = [0]
+        go = threading.Event()
+
+        def spin():
+            go.wait()  # count only inside the timed window
+            c = 0
+            while not stop[0]:
+                c += 1
+            count[0] = c
+
+        t = threading.Thread(target=spin)
+        t.start()
+        time.sleep(0.05)  # thread up and parked on the event
+        t0 = time.perf_counter()
+        go.set()
+        for _ in range(runs):
+            during()
+        dt = time.perf_counter() - t0
+        stop[0] = True
+        t.join()
+        return count[0] / dt, dt / runs
+
+    # baseline: the SAME tight counter loop with no competing work (the
+    # loop body must match the probe's exactly for rates to compare)
+    base_rate, _ = counter_rate(lambda: time.sleep(0.1), runs=5)
+
+    for name, fn in stages.items():
+        fn()  # warm (file cache, PIL lazy init)
+        rate, stage_s = counter_rate(fn)
+        print(json.dumps({
+            "stage": name,
+            "stage_ms": round(stage_s * 1e3, 1),
+            "counter_ratio_vs_idle": round(rate / base_rate, 3),
+            "gil": "released" if rate / base_rate > 0.3 else "HELD",
+        }))
+
+
+def main() -> None:
+    n = 1024
+    if "--n" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--n") + 1])
+    cache = build_splits(n)
+    sweep_workers(cache)
+    gil_release_probe()
+
+
+if __name__ == "__main__":
+    main()
