@@ -144,7 +144,9 @@ class Attention(nn.Module):
             # KV cache. ``position_offset`` is the single source of
             # position truth — the write index, the attention mask, AND
             # the positional embedding all derive from it, so they cannot
-            # silently disagree (no per-layer counter to drift).
+            # silently disagree (no per-layer counter to drift). In decode
+            # mode it may be a PER-REQUEST [B] vector (ragged serving:
+            # each request writes its own cache slot).
             max_len = cfg.max_seq_len
             ck = self.variable(
                 "cache", "key",
@@ -155,12 +157,22 @@ class Attention(nn.Module):
                 lambda: jnp.zeros((b, max_len, heads_local, head_dim), cfg.dtype),
             )
             pos = jnp.asarray(position_offset, jnp.int32)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, pos, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, pos, 0, 0)
-            )
+            if self.decode and pos.ndim == 1:
+                # per-request slot write (l == 1, asserted below)
+                rows = jnp.arange(b)
+                ck.value = ck.value.at[rows, pos].set(
+                    k[:, 0].astype(cfg.dtype)
+                )
+                cv.value = cv.value.at[rows, pos].set(
+                    v[:, 0].astype(cfg.dtype)
+                )
+            else:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(cfg.dtype), (0, pos, 0, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(cfg.dtype), (0, pos, 0, 0)
+                )
 
         if self.decode:
             # Single-token step attending against the cache (O(L) per
@@ -168,12 +180,14 @@ class Attention(nn.Module):
             # tests/test_generate.py.
             assert l == 1, f"decode mode processes one token/step, got {l}"
             pos = jnp.asarray(position_offset, jnp.int32)
+            pos_b = pos if pos.ndim == 1 else jnp.full((b,), pos)
             scale = head_dim**-0.5
             s = jnp.einsum(
                 "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
                 ck.value.astype(jnp.float32),
             )  # [B, H, 1, max_len]
-            mask = jnp.arange(cfg.max_seq_len)[None, None, None, :] <= pos
+            mask = (jnp.arange(cfg.max_seq_len)[None, None, None, :]
+                    <= pos_b[:, None, None, None])
             s = jnp.where(mask, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             out = jnp.einsum(
@@ -371,9 +385,23 @@ class TransformerLM(nn.Module):
                 "it, and shard batches with shard_lm_batch(..., "
                 "layout='zigzag')."
             )
-        pos = (positions if positions is not None
-               else position_offset + jnp.arange(tokens.shape[1]))
-        x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype, name="wpe")(pos)
+        wpe = nn.Embed(cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype,
+                       name="wpe")
+        off = jnp.asarray(position_offset, jnp.int32)
+        if positions is not None:
+            x = x + wpe(positions)
+        elif off.ndim == 1:
+            # per-request decode positions [B] (ragged serving): one token
+            # per row, each at its own absolute position
+            if not decode or tokens.shape[1] != 1:
+                raise ValueError(
+                    "a [B] position_offset vector is the ragged DECODE "
+                    "convention (one token per request); prefill/training "
+                    "use a scalar offset or positions="
+                )
+            x = x + wpe(off)[:, None, :]
+        else:
+            x = x + wpe(off + jnp.arange(tokens.shape[1]))
         if cfg.dropout and not inference:
             x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
         for i in range(cfg.num_layers):

@@ -1,0 +1,98 @@
+"""Ragged-serving throughput (VERDICT r3 #10 done-condition: measured
+tok/s at batch 32).
+
+GPT-2-small-shaped decode config, 32 requests with random prompt lengths
+in [16, 256] right-padded to 256, greedy. Measures:
+
+- ragged prefill latency (one batched causal forward, all 32 prompts);
+- steady-state DECODE throughput (tokens/s across the 32 slots) via the
+  chained generate_ragged scan — timing per PERF_NOTES.md (scalar-fetch
+  sync, round-trip subtracted).
+
+Usage: python scripts/bench_serving.py [--slots 32]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import measure_roundtrip_s  # noqa: E402  (scripts on path via cwd)
+
+
+def main() -> None:
+    from pytorch_distributed_tpu.models.generate import (
+        generate_ragged,
+        ragged_prefill,
+    )
+    from pytorch_distributed_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    slots = 32
+    if "--slots" in sys.argv:
+        slots = int(sys.argv[sys.argv.index("--slots") + 1])
+    max_new = 64
+    cfg = TransformerConfig(
+        vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
+        max_seq_len=1024, dtype=jnp.bfloat16, attention="dense",
+    )
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(16, 257, slots).astype(np.int32)
+    prompts = np.zeros((slots, 256), np.int32)
+    for i, l in enumerate(lengths):
+        prompts[i, :l] = rng.integers(1, cfg.vocab_size, l)
+    prompts_j = jnp.asarray(prompts)
+    lengths_j = jnp.asarray(lengths)
+
+    # prefill latency (compile, then time the steady call)
+    pf = jax.jit(lambda p, pr, ln: ragged_prefill(cfg, p, pr, ln))
+    cache, last = pf(params, prompts_j, lengths_j)
+    float(jnp.sum(last[:, :1]))
+    t0 = time.perf_counter()
+    cache, last = pf(params, prompts_j, lengths_j)
+    float(jnp.sum(last[:, :1]))
+    prefill_s = max(
+        time.perf_counter() - t0 - measure_roundtrip_s(), 1e-6
+    )
+
+    # decode throughput: the full ragged generate (prefill + max_new
+    # decode steps); subtract the measured prefill to isolate decode
+    out = generate_ragged(cfg, params, prompts_j, lengths_j,
+                          jax.random.key(1), max_new_tokens=max_new)
+    int(np.asarray(out)[0, 0])  # compile + drain
+    t0 = time.perf_counter()
+    out = generate_ragged(cfg, params, prompts_j, lengths_j,
+                          jax.random.key(1), max_new_tokens=max_new)
+    int(np.asarray(out)[0, 0])
+    total_s = max(time.perf_counter() - t0 - measure_roundtrip_s(), 1e-6)
+    decode_s = max(total_s - prefill_s, 1e-6)
+
+    print(json.dumps({
+        "serving_slots": slots,
+        "prompt_lens": f"{int(lengths.min())}-{int(lengths.max())}",
+        "max_new_tokens": max_new,
+        "prefill_ms": round(prefill_s * 1e3, 1),
+        "prefill_prompt_tok_s": round(float(lengths.sum()) / prefill_s),
+        "decode_tok_s": round(slots * max_new / decode_s),
+        "decode_ms_per_token": round(decode_s / max_new * 1e3, 2),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()
