@@ -172,9 +172,12 @@ def test_lm_trainer_two_process_tp_sharded_checkpoint(tmp_path):
     assert r0["sharded_ckpt_ok"] and r1["sharded_ckpt_ok"]
     assert os.path.isdir(os.path.join(save, "best.ckpt"))
     assert os.path.isdir(os.path.join(save, "latest.ckpt"))
+    import glob
+
     for r in (0, 1):
-        assert os.path.exists(
-            os.path.join(save, "latest.ckpt", f"shard-{r:05d}.npz")
+        # r4 layout: token-named shard files (shard-<token>-NNNNN.npz)
+        assert glob.glob(
+            os.path.join(save, "latest.ckpt", f"shard-*-{r:05d}.npz")
         )
 
 
