@@ -288,6 +288,19 @@ def main() -> None:
             record.update(bench_lm_training())
         except Exception as e:
             record["lm_error"] = str(e)[:200]
+    if not tiny and os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            import sys as _sys
+
+            _sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "scripts"))
+            import bench_serving
+
+            r = bench_serving.measure(slots=32, max_new=64)
+            r.pop("device", None)
+            record.update(r)
+        except Exception as e:
+            record["serving_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_FP32", "1") == "1":
         fp32_bs = batch_size
         while True:

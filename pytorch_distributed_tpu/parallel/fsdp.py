@@ -108,20 +108,29 @@ def _sharded_dim(spec: P, axis: str) -> Optional[int]:
     return None
 
 
-def gather_params(params: Any, specs: Any, axis: str = DATA_AXIS) -> Any:
+def gather_params(params: Any, specs: Any, axis: str = DATA_AXIS,
+                  mask: Any = None) -> Any:
     """all_gather each sharded leaf back to full size (inside shard_map).
 
     XLA schedules these independently, overlapping with the forward ops that
     consume them — torch FSDP's unshard-prefetch, for free.
+
+    ``mask`` (optional boolean tree): gather only masked leaves — the LM
+    step's mixed-placement case, where TP/EP compute shards also name
+    mesh axes in their specs but must stay sharded.
     """
 
-    def gather(leaf, spec):
+    def gather(leaf, spec, m=True):
+        if not m:
+            return leaf
         d = _sharded_dim(spec, axis)
         if d is None:
             return leaf
         return jax.lax.all_gather(leaf, axis, axis=d, tiled=True)
 
-    return jax.tree.map(gather, params, specs)
+    if mask is None:
+        return jax.tree.map(gather, params, specs)
+    return jax.tree.map(gather, params, specs, mask)
 
 
 def scatter_grads(grads: Any, specs: Any, axis: str = DATA_AXIS) -> Any:

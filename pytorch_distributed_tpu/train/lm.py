@@ -169,7 +169,7 @@ def lm_state_specs(state: TrainState, rules=None, config=None) -> TrainState:
 
 
 def shard_lm_state(
-    mesh: Mesh, state: TrainState, config=None
+    mesh: Mesh, state: TrainState, config=None, fsdp: bool = False
 ) -> Tuple[TrainState, TrainState]:
     """Place a (host or replicated) state onto the mesh per the TP/EP rules.
 
@@ -178,6 +178,12 @@ def shard_lm_state(
     ``config`` is required for MoE models (see ``lm_state_specs``) and is
     validated against the mesh: expert parallelism must span exactly the
     data axis, and a seq-sharded mesh requires ring attention.
+
+    ``fsdp=True`` additionally ZeRO-shards the leaves the TP/EP rules
+    leave REPLICATED over the data axis (storage only — the train step
+    all_gathers them before the forward and reduce-scatters their grads;
+    ``parallel.fsdp``). TP/EP placements are untouched, so FSDP composes
+    with every other axis.
     """
     if config is not None:
         check_seq_parallel_attention(mesh, config)
@@ -196,7 +202,99 @@ def shard_lm_state(
     from pytorch_distributed_tpu.parallel.mesh import specs_to_shardings
 
     specs = lm_state_specs(state, config=config)
+    if fsdp:
+        specs = _overlay_fsdp_specs(specs, state, mesh, config)
     return jax.device_put(state, specs_to_shardings(mesh, specs)), specs
+
+
+def _lm_placement_rules(tree, config):
+    """The TP(+EP) rule set for a params-shaped tree (paths only); MoE
+    trees require the config so EP's data-axis expert shards are
+    distinguishable from FSDP storage shards."""
+    rules = TRANSFORMER_TP_RULES
+    if _has_moe_params(tree):
+        if config is None:
+            raise ValueError(
+                "FSDP over a MoE state needs the TransformerConfig — "
+                "without it EP's data-axis expert shards are "
+                "indistinguishable from FSDP storage shards"
+            )
+        rules = rules + _moe_rules(config)
+    return rules
+
+
+def _rule_claimed(name: str, rules, mesh: Mesh) -> bool:
+    """True if a TP/EP rule EFFECTIVELY claims this path: a matched rule
+    whose every named mesh axis has size 1 shards nothing (tp=1 meshes —
+    the Megatron specs are vacuous there, so the block matrices, most of
+    the model, correctly fall through to ZeRO). The ONE shared claim
+    test for the overlay and the step."""
+    import re
+
+    for pattern, spec in rules:
+        if re.search(pattern, name):
+            return any(mesh.shape.get(a, 1) > 1 for a in spec_axes(spec))
+    return False
+
+
+def lm_fsdp_membership(params, mesh: Mesh, config=None,
+                       data_axis: str = DATA_AXIS):
+    """Boolean params-shaped tree: which leaves the FSDP overlay shards —
+    big enough for ``fsdp_dim`` and not effectively rule-claimed.
+    ``params`` must carry GLOBAL shapes (use outside shard_map; local
+    tracer shapes would misapply the min-shard threshold)."""
+    from pytorch_distributed_tpu.parallel.fsdp import fsdp_dim
+    from pytorch_distributed_tpu.parallel.tensor import path_str
+
+    rules = _lm_placement_rules(params, config)
+    data_size = mesh.shape[data_axis]
+
+    def member(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if fsdp_dim(shape, data_size) is None:
+            return False  # tiny / indivisible: replicate
+        return not _rule_claimed(path_str(path), rules, mesh)
+
+    return jax.tree_util.tree_map_with_path(member, params)
+
+
+def _fsdp_gather_tree(specs_params, mesh: Mesh, config=None,
+                      data_axis: str = DATA_AXIS):
+    """Step-side gather mask, derived from the overlay's OUTPUT (the
+    storage spec tree) so it cannot diverge from the storage decision:
+    a leaf is gathered iff its storage spec names the data axis and no
+    rule effectively claims it (EP expert shards also name data — the
+    shared ``_rule_claimed`` excludes them)."""
+    from pytorch_distributed_tpu.parallel.tensor import path_str
+
+    rules = _lm_placement_rules(specs_params, config)
+
+    def is_gather(path, storage):
+        if data_axis not in spec_axes(storage):
+            return False
+        return not _rule_claimed(path_str(path), rules, mesh)
+
+    return jax.tree_util.tree_map_with_path(is_gather, specs_params)
+
+
+def _overlay_fsdp_specs(specs: TrainState, state: TrainState, mesh: Mesh,
+                        config=None) -> TrainState:
+    """ZeRO overlay: every ``lm_fsdp_membership`` leaf gets the FSDP
+    data-axis placement (largest divisible dim); opt-state follows.
+    Rule-claimed leaves keep their compute placement."""
+    from pytorch_distributed_tpu.parallel.fsdp import fsdp_param_specs
+    from pytorch_distributed_tpu.parallel.tensor import opt_state_specs
+
+    fsdp_specs = fsdp_param_specs(state.params, mesh, DATA_AXIS)
+    members = lm_fsdp_membership(state.params, mesh, config)
+    param_specs = jax.tree.map(
+        lambda tp_spec, fs_spec, m: fs_spec if m else tp_spec,
+        specs.params, fsdp_specs, members,
+    )
+    return specs.replace(
+        params=param_specs,
+        opt_state=opt_state_specs(state.params, param_specs, state.tx),
+    )
 
 
 def _shard_positions(config, lq: int, seq_axis: str):
@@ -253,6 +351,7 @@ def make_lm_train_step(
     config=None,
     dropout_seed: int = 0,
     grad_clip_norm: float = 0.0,
+    fsdp: bool = False,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -277,6 +376,15 @@ def make_lm_train_step(
         check_seq_parallel_attention(mesh, config, seq_axis)
     use_dropout = config is not None and getattr(config, "dropout", 0.0) > 0.0
     axes = (data_axis, seq_axis)
+    if fsdp and state_specs is None:
+        raise ValueError(
+            "fsdp=True needs state_specs (from shard_lm_state(..., "
+            "fsdp=True)) — the gather/scatter dims live in the spec tree"
+        )
+    gather_tree = (
+        _fsdp_gather_tree(state_specs.params, mesh, config, data_axis)
+        if fsdp else None
+    )
 
     def _local_step(state: TrainState, batch: dict):
         lq = batch["tokens"].shape[1]
@@ -301,6 +409,19 @@ def make_lm_train_step(
             rngs = {"dropout": jax.random.fold_in(key, shard)}
         else:
             rngs = None
+
+        if gather_tree is not None:
+            # ZeRO unshard: all_gather only the FSDP-owned storage shards
+            # (TP/EP leaves stay compute-sharded); XLA overlaps the
+            # gathers with the forward ops that consume them.
+            from pytorch_distributed_tpu.parallel.fsdp import gather_params
+
+            model_params = gather_params(
+                state.params, state_specs.params, data_axis,
+                mask=gather_tree,
+            )
+        else:
+            model_params = state.params
 
         def loss_fn(params):
             logits, mutated = state.apply_fn(
@@ -329,20 +450,37 @@ def make_lm_train_step(
         # global mean loss w.r.t. the replicated params.
         (local_loss, mutated), grads = jax.value_and_grad(
             loss_fn, has_aux=True
-        )(state.params)
+        )(model_params)
         loss = jax.lax.psum(local_loss, axes)
         if state_specs is None:
             grads = jax.lax.psum(grads, axes)
         else:
             # A parameter sharded over some axis (TP over model, EP over
             # data) owns its gradient there; psum only over the axes its
-            # spec does NOT shard.
-            def _reduce(g, spec):
+            # spec does NOT shard. FSDP leaves (storage shards, gathered
+            # above) take the ZeRO reduce-scatter instead: psum_scatter
+            # over data returns exactly the shard this device owns, SUM
+            # semantics matching the share-of-global-mean loss convention,
+            # then a plain psum over the seq axis completes the combine.
+            from pytorch_distributed_tpu.parallel.fsdp import _sharded_dim
+
+            def _reduce(g, spec, is_fsdp=False):
+                if is_fsdp:
+                    d = _sharded_dim(spec, data_axis)
+                    g = jax.lax.psum_scatter(
+                        g, data_axis, scatter_dimension=d, tiled=True
+                    )
+                    return jax.lax.psum(g, seq_axis)
                 named = spec_axes(spec)
                 ax = tuple(a for a in axes if a not in named)
                 return jax.lax.psum(g, ax) if ax else g
 
-            grads = jax.tree.map(_reduce, grads, state_specs.params)
+            if gather_tree is not None:
+                grads = jax.tree.map(
+                    _reduce, grads, state_specs.params, gather_tree
+                )
+            else:
+                grads = jax.tree.map(_reduce, grads, state_specs.params)
         count = global_count
 
         grad_norm = None
@@ -391,6 +529,7 @@ def make_lm_eval_step(
     seq_axis: str = SEQ_AXIS,
     state_specs: Optional[TrainState] = None,
     config=None,
+    fsdp: bool = False,
 ) -> Callable[[TrainState, dict, dict], dict]:
     """Compiled evaluation step: ``eval_step(state, batch, acc) -> acc``.
 
@@ -427,12 +566,31 @@ def make_lm_eval_step(
         eval_cfg = dataclasses.replace(config, capacity_factor=eval_cf)
         eval_apply = TransformerLM(eval_cfg).apply
 
+    if fsdp and state_specs is None:
+        raise ValueError(
+            "fsdp=True needs state_specs (from shard_lm_state(..., "
+            "fsdp=True))"
+        )
+    eval_gather_tree = (
+        _fsdp_gather_tree(state_specs.params, mesh, config, data_axis)
+        if fsdp else None
+    )
+
     def _local_eval(state: TrainState, batch: dict, acc: dict):
         lq = batch["tokens"].shape[1]
         positions, offset = _shard_positions(config, lq, seq_axis)
         apply_fn = eval_apply if eval_apply is not None else state.apply_fn
+        if eval_gather_tree is not None:
+            from pytorch_distributed_tpu.parallel.fsdp import gather_params
+
+            model_params = gather_params(
+                state.params, state_specs.params, data_axis,
+                mask=eval_gather_tree,
+            )
+        else:
+            model_params = state.params
         logits = apply_fn(
-            {"params": state.params},
+            {"params": model_params},
             batch["tokens"],
             position_offset=offset,
             positions=positions,

@@ -104,6 +104,11 @@ class LMTrainerConfig:
     # (ops.optim.sharded_global_norm) — the loss-spike control the
     # reference's SGD ResNet never needed but an LM does.
     grad_clip_norm: float = 0.0
+    # FSDP/ZeRO for the LM: leaves the TP/EP rules leave replicated shard
+    # over the data axis at rest; the step all_gathers them before the
+    # forward and reduce-scatters their grads (train/lm.py round 4 —
+    # composes with TP, EP, SP, clipping, and the sharded checkpointer).
+    fsdp: bool = False
 
 
 class LMTrainer(SuspendableTrainer):
@@ -160,14 +165,16 @@ class LMTrainer(SuspendableTrainer):
         )
         state = create_lm_state(model_config, tx, jax.random.key(config.seed))
         self.state, self.state_specs = shard_lm_state(
-            self.mesh, state, model_config
+            self.mesh, state, model_config, fsdp=config.fsdp
         )
         self.train_step = make_lm_train_step(
             self.mesh, state_specs=self.state_specs, config=model_config,
             dropout_seed=config.seed, grad_clip_norm=config.grad_clip_norm,
+            fsdp=config.fsdp,
         )
         self.eval_step = make_lm_eval_step(
-            self.mesh, state_specs=self.state_specs, config=model_config
+            self.mesh, state_specs=self.state_specs, config=model_config,
+            fsdp=config.fsdp,
         )
         # pre-fault the checkpoint snapshot arena while the first step
         # compiles — the first non-blocking best-save then stalls only for

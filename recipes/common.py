@@ -194,6 +194,10 @@ def parse_lm_args(description: str) -> argparse.Namespace:
                    help="causal-ring shard layout; zigzag balances the "
                         "causal critical path across seq shards "
                         "(parallel/sequence.py)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-shard replicated params/optimizer over the "
+                        "data axis (gather/scatter in the step; composes "
+                        "with TP/EP/SP)")
     p.add_argument("--model-parallel", type=int, default=1,
                    help="tensor-parallel degree")
     return p.parse_args()

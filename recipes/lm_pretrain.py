@@ -125,6 +125,7 @@ def main() -> None:
         save_dir=args.save_dir,
         num_workers=0 if args.tiny else 4,
         grad_clip_norm=args.grad_clip_norm,
+        fsdp=args.fsdp,
     )
     trainer = LMTrainer(model_cfg, train_ds, val_ds, cfg, mesh=mesh,
                         suspend_watcher=SuspendWatcher())

@@ -29,7 +29,7 @@ import numpy as np
 from bench import measure_roundtrip_s  # noqa: E402  (scripts on path via cwd)
 
 
-def main() -> None:
+def measure(slots: int = 32, max_new: int = 64) -> dict:
     from pytorch_distributed_tpu.models.generate import (
         generate_ragged,
         ragged_prefill,
@@ -38,11 +38,6 @@ def main() -> None:
         TransformerConfig,
         TransformerLM,
     )
-
-    slots = 32
-    if "--slots" in sys.argv:
-        slots = int(sys.argv[sys.argv.index("--slots") + 1])
-    max_new = 64
     cfg = TransformerConfig(
         vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
         max_seq_len=1024, dtype=jnp.bfloat16, attention="dense",
@@ -82,16 +77,25 @@ def main() -> None:
     total_s = max(time.perf_counter() - t0 - measure_roundtrip_s(), 1e-6)
     decode_s = max(total_s - prefill_s, 1e-6)
 
-    print(json.dumps({
+    return {
         "serving_slots": slots,
-        "prompt_lens": f"{int(lengths.min())}-{int(lengths.max())}",
-        "max_new_tokens": max_new,
-        "prefill_ms": round(prefill_s * 1e3, 1),
-        "prefill_prompt_tok_s": round(float(lengths.sum()) / prefill_s),
-        "decode_tok_s": round(slots * max_new / decode_s),
-        "decode_ms_per_token": round(decode_s / max_new * 1e3, 2),
+        "serving_prompt_lens": f"{int(lengths.min())}-{int(lengths.max())}",
+        "serving_max_new_tokens": max_new,
+        "serving_prefill_ms": round(prefill_s * 1e3, 1),
+        "serving_prefill_prompt_tok_s": round(
+            float(lengths.sum()) / prefill_s
+        ),
+        "serving_decode_tok_s": round(slots * max_new / decode_s),
+        "serving_decode_ms_per_token": round(decode_s / max_new * 1e3, 2),
         "device": str(jax.devices()[0]),
-    }))
+    }
+
+
+def main() -> None:
+    slots = 32
+    if "--slots" in sys.argv:
+        slots = int(sys.argv[sys.argv.index("--slots") + 1])
+    print(json.dumps(measure(slots)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,152 @@
+"""FSDP/ZeRO for the LM trainer (round 4): leaves the TP/EP rules leave
+replicated shard over the data axis at rest; the step all_gathers them
+before the forward and reduce-scatters their grads with the LM's
+sum-convention combine. Parity with the non-FSDP path is the whole
+contract — plus the memory win and composition with TP/SP/EP/clipping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+pytestmark = pytest.mark.slow
+
+from pytorch_distributed_tpu.models.transformer import tiny_config
+from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
+from pytorch_distributed_tpu.parallel import make_mesh
+from pytorch_distributed_tpu.train.lm import (
+    create_lm_state,
+    empty_lm_metrics,
+    lm_fsdp_membership,
+    make_lm_eval_step,
+    make_lm_train_step,
+    shard_lm_state,
+    shift_labels,
+)
+from pytorch_distributed_tpu.train.lm_trainer import shard_lm_batch
+
+
+def batch(mesh, seed=0, b=4, l=32):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, 128, (b, l)).astype(np.int32)
+    labels, weights = shift_labels(tokens)
+    return shard_lm_batch(
+        mesh, {"tokens": tokens, "labels": labels, "weights": weights}
+    )
+
+
+def run(mesh, cfg, fsdp, steps=3, clip=0.0, tx=None):
+    tx = tx or sgd_with_weight_decay(0.1, momentum=0.9)
+    state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+    state, specs = shard_lm_state(mesh, state, cfg, fsdp=fsdp)
+    step = make_lm_train_step(mesh, state_specs=specs, config=cfg,
+                              fsdp=fsdp, grad_clip_norm=clip)
+    losses = []
+    for i in range(steps):
+        state, m = step(state, batch(mesh, seed=i))
+        losses.append(float(m["loss"]))
+    return state, specs, losses
+
+
+def assert_params_match(state_a, state_b, rtol=1e-4, atol=1e-6):
+    flat_b = {str(p): v for p, v in
+              jax.tree_util.tree_leaves_with_path(state_b.params)}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state_a.params):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_b[str(path)]),
+            rtol=rtol, atol=atol, err_msg=str(path),
+        )
+
+
+def test_lm_fsdp_matches_replicated(devices8):
+    mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    cfg = tiny_config(attention="ring")
+    state_f, specs, losses_f = run(mesh, cfg, fsdp=True)
+    state_r, _, losses_r = run(mesh, cfg, fsdp=False)
+    np.testing.assert_allclose(losses_f, losses_r, rtol=1e-4)
+    assert_params_match(state_f, state_r)
+    # the memory win is real: at least the big matrices are data-sharded
+    gather = lm_fsdp_membership(state_f.params, mesh, cfg)
+    n_sharded = sum(jax.tree.leaves(gather))
+    # tp=1 mesh: the Megatron rules are vacuous here, so the big block
+    # matrices fall through to ZeRO along with wte/wpe/lm_head
+    assert n_sharded >= 6, n_sharded
+    flat_specs = {str(p): v for p, v in
+                  jax.tree_util.tree_leaves_with_path(specs.params)}
+    flat_gather = {str(p): v for p, v in
+                   jax.tree_util.tree_leaves_with_path(gather)}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state_f.params):
+        if not flat_gather[str(path)]:
+            continue
+        spec = flat_specs[str(path)]
+        d = next(i for i, part in enumerate(spec) if part is not None)
+        assert {s.data.shape[d] for s in leaf.addressable_shards} == {
+            leaf.shape[d] // 4
+        }, path
+
+
+def test_lm_fsdp_composes_with_tp(devices8):
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=2,
+                     model_parallel=2)
+    cfg = tiny_config(attention="ring", model_axis="model", tp_size=2)
+    state_f, specs, losses_f = run(mesh, cfg, fsdp=True)
+    state_r, specs_r, losses_r = run(mesh, cfg, fsdp=False)
+    np.testing.assert_allclose(losses_f, losses_r, rtol=1e-4)
+    assert_params_match(state_f, state_r, rtol=2e-4, atol=2e-6)
+    # TP leaves keep their Megatron placement (never double-sharded over
+    # data by the overlay; never gathered)
+    gather = lm_fsdp_membership(state_f.params, mesh, cfg)
+    qkv_spec = specs.params["block0"]["attn"]["qkv"]["kernel"]
+    assert qkv_spec == specs_r.params["block0"]["attn"]["qkv"]["kernel"]
+    assert not gather["block0"]["attn"]["qkv"]["kernel"]
+
+
+def test_lm_fsdp_with_ep_moe(devices8):
+    mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    cfg = tiny_config(
+        attention="ring", n_experts=4, moe_every=2,
+        capacity_factor=float(4 * 8), moe_aux_weight=0.0,
+        expert_axis="data", ep_size=4,
+    )
+    state_f, specs, losses_f = run(mesh, cfg, fsdp=True)
+    state_r, _, losses_r = run(mesh, cfg, fsdp=False)
+    np.testing.assert_allclose(losses_f, losses_r, rtol=5e-4)
+    assert_params_match(state_f, state_r, rtol=2e-3, atol=3e-5)
+    # expert leaves stay EP shards (data axis), NOT gather targets
+    gather = lm_fsdp_membership(state_f.params, mesh, cfg)
+    assert not gather["block1"]["moe"]["w_up"]
+
+
+def test_lm_fsdp_with_grad_clip(devices8):
+    """sharded_global_norm over the MIXED spec tree (FSDP + replicated
+    leaves) must equal the replicated run's clipped trajectory."""
+    mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    cfg = tiny_config(attention="ring")
+    state_f, _, losses_f = run(mesh, cfg, fsdp=True, clip=0.05)
+    state_r, _, losses_r = run(mesh, cfg, fsdp=False, clip=0.05)
+    np.testing.assert_allclose(losses_f, losses_r, rtol=1e-4)
+    assert_params_match(state_f, state_r)
+
+
+def test_lm_fsdp_eval_matches(devices8):
+    mesh = make_mesh(devices8, data_parallel=4, seq_parallel=2)
+    cfg = tiny_config(attention="ring")
+    tx = sgd_with_weight_decay(0.1)
+
+    def evaluate(fsdp):
+        state = create_lm_state(cfg, tx, jax.random.key(0), init_len=8)
+        state, specs = shard_lm_state(mesh, state, cfg, fsdp=fsdp)
+        ev = make_lm_eval_step(mesh, state_specs=specs, config=cfg,
+                               fsdp=fsdp)
+        acc = jax.device_put(empty_lm_metrics(), NamedSharding(mesh, P()))
+        acc = jax.device_get(ev(state, batch(mesh, seed=9), acc))
+        return float(acc["loss_sum"]) / float(acc["tokens"])
+
+    np.testing.assert_allclose(evaluate(True), evaluate(False), rtol=1e-5)
+
+
+def test_lm_fsdp_requires_specs():
+    mesh = make_mesh(jax.devices("cpu")[:1])
+    with pytest.raises(ValueError, match="fsdp=True needs state_specs"):
+        make_lm_train_step(mesh, fsdp=True)
