@@ -109,6 +109,13 @@ class LMTrainerConfig:
     # forward and reduce-scatters their grads (train/lm.py round 4 —
     # composes with TP, EP, SP, clipping, and the sharded checkpointer).
     fsdp: bool = False
+    # Pipeline parallelism: > 0 trains through the GPipe executor with
+    # this many stages on the mesh's model axis (train/pp.py). The batch
+    # shards over data only (seq axis must be 1); TP-within-PP and FSDP
+    # need the lower-level API (a custom stage axis) and are rejected
+    # here. pp_microbatches follows BENCH_PP.md's measured default.
+    pipeline_stages: int = 0
+    pp_microbatches: int = 8
 
 
 class LMTrainer(SuspendableTrainer):
@@ -163,19 +170,86 @@ class LMTrainer(SuspendableTrainer):
         tx = build_optimizer(
             config.optimizer, schedule, weight_decay=config.weight_decay
         )
-        state = create_lm_state(model_config, tx, jax.random.key(config.seed))
-        self.state, self.state_specs = shard_lm_state(
-            self.mesh, state, model_config, fsdp=config.fsdp
-        )
-        self.train_step = make_lm_train_step(
-            self.mesh, state_specs=self.state_specs, config=model_config,
-            dropout_seed=config.seed, grad_clip_norm=config.grad_clip_norm,
-            fsdp=config.fsdp,
-        )
-        self.eval_step = make_lm_eval_step(
-            self.mesh, state_specs=self.state_specs, config=model_config,
-            fsdp=config.fsdp,
-        )
+        if config.pipeline_stages > 0:
+            from pytorch_distributed_tpu.train.pp import (
+                create_pp_lm_state,
+                make_pp_lm_eval_step,
+                make_pp_lm_train_step,
+                shard_pp_state,
+            )
+
+            s = config.pipeline_stages
+            if self.mesh.shape.get("model", 1) != s:
+                raise ValueError(
+                    f"pipeline_stages={s} needs the mesh's model axis to "
+                    f"carry the stages (got {self.mesh.shape.get('model')}); "
+                    "build the mesh with model_parallel == pipeline_stages"
+                )
+            if self.mesh.shape.get("seq", 1) > 1:
+                raise ValueError(
+                    "the PP trainer shards batches over data only; use "
+                    "seq_parallel=1 (ring attention cannot run inside a "
+                    "pipeline stage)"
+                )
+            if model_config.model_axis is not None:
+                raise ValueError(
+                    "TP-within-PP needs a dedicated stage axis — use "
+                    "train.pp directly with a (data, stage, model) mesh; "
+                    "the trainer runs stages on the model axis"
+                )
+            if config.fsdp:
+                raise ValueError(
+                    "fsdp does not compose with pipeline_stages in the "
+                    "trainer (stage stacks already shard the model axis)"
+                )
+            state = create_pp_lm_state(
+                model_config, s, tx, jax.random.key(config.seed)
+            )
+            self.state, self.state_specs = shard_pp_state(
+                self.mesh, state, config=model_config
+            )
+            # microbatches divide the PER-DATA-SHARD batch, which is
+            # config.batch_size by definition; clamp for small runs
+            if config.pp_microbatches < 1:
+                raise ValueError(
+                    f"pp_microbatches must be >= 1, got "
+                    f"{config.pp_microbatches}"
+                )
+            mb = min(config.pp_microbatches, config.batch_size)
+            while config.batch_size % mb:
+                mb -= 1
+            if mb != config.pp_microbatches:
+                rank0_print(
+                    f"pp_microbatches {config.pp_microbatches} -> {mb} "
+                    f"(must divide the per-shard batch {config.batch_size})"
+                )
+            self.train_step = make_pp_lm_train_step(
+                self.mesh, model_config, self.state_specs,
+                n_microbatches=mb,
+                dropout_seed=config.seed,
+                grad_clip_norm=config.grad_clip_norm,
+            )
+            self.eval_step = make_pp_lm_eval_step(
+                self.mesh, model_config, self.state_specs,
+                n_microbatches=mb,
+            )
+        else:
+            state = create_lm_state(
+                model_config, tx, jax.random.key(config.seed)
+            )
+            self.state, self.state_specs = shard_lm_state(
+                self.mesh, state, model_config, fsdp=config.fsdp
+            )
+            self.train_step = make_lm_train_step(
+                self.mesh, state_specs=self.state_specs, config=model_config,
+                dropout_seed=config.seed,
+                grad_clip_norm=config.grad_clip_norm,
+                fsdp=config.fsdp,
+            )
+            self.eval_step = make_lm_eval_step(
+                self.mesh, state_specs=self.state_specs, config=model_config,
+                fsdp=config.fsdp,
+            )
         # pre-fault the checkpoint snapshot arena while the first step
         # compiles — the first non-blocking best-save then stalls only for
         # its memcpy (see utils.checkpoint._Arena)

@@ -520,6 +520,54 @@ def make_pp_lm_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def make_pp_lm_eval_step(
+    mesh: Mesh,
+    config: TransformerConfig,
+    state_specs: TrainState,
+    n_microbatches: int = 8,
+    data_axis: str = DATA_AXIS,
+    axis: str = MODEL_AXIS,
+) -> Callable[[TrainState, dict, dict], dict]:
+    """Validation under the pipeline: the same gpipe schedule forward-only
+    (dropout off), loss summed on the last stage and psum'd global —
+    ``eval_step(state, batch, acc) -> acc`` with the LM eval accumulator
+    contract (``train.lm.empty_lm_metrics``)."""
+    n_stages = mesh.shape[axis]
+    if config.num_layers % n_stages:
+        raise ValueError(
+            f"num_layers {config.num_layers} not divisible by "
+            f"{axis!r}={n_stages}"
+        )
+    lps = config.num_layers // n_stages
+
+    def _local_eval(state: TrainState, batch: dict, acc: dict):
+        local_sum, _ = _pp_loss(
+            config, lps, state.params, batch, n_microbatches, axis,
+            dropout_key=None,
+        )
+        my_stage = jax.lax.axis_index(axis)
+        n_stages_rt = jax.lax.psum(1, axis)
+        mask = (my_stage == n_stages_rt - 1).astype(jnp.float32)
+        # the masked psum over (data, stage) picks exactly the last
+        # stages' real sums; token counts are stage-replicated, so they
+        # reduce over data only
+        loss_sum = jax.lax.psum(mask * local_sum, (data_axis, axis))
+        tokens = jax.lax.psum(jnp.sum(batch["weights"]), data_axis)
+        return {
+            "loss_sum": acc["loss_sum"] + loss_sum,
+            "tokens": acc["tokens"] + tokens,
+        }
+
+    sharded = shard_map(
+        _local_eval,
+        mesh=mesh,
+        in_specs=(state_specs, P(data_axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
 def make_pp_reference_step(
     config: TransformerConfig,
     n_stages: int,

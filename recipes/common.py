@@ -198,6 +198,14 @@ def parse_lm_args(description: str) -> argparse.Namespace:
                    help="ZeRO-shard replicated params/optimizer over the "
                         "data axis (gather/scatter in the step; composes "
                         "with TP/EP/SP)")
+    p.add_argument("--pipeline-stages", type=int, default=0,
+                   help="train through the GPipe pipeline with this many "
+                        "stages on the model axis (0 = off; excludes "
+                        "--model-parallel/--seq-parallel)")
+    p.add_argument("--pp-microbatches", type=int, default=8,
+                   help="GPipe microbatches per step (clamped to the "
+                        "per-shard batch; 8 is the measured default, "
+                        "BENCH_PP.md)")
     p.add_argument("--model-parallel", type=int, default=1,
                    help="tensor-parallel degree")
     return p.parse_args()

@@ -76,11 +76,38 @@ def main() -> None:
 
     sp = args.seq_parallel
     tp = args.model_parallel
+    if args.pipeline_stages < 0:
+        raise SystemExit(
+            f"--pipeline-stages must be >= 1 (or 0 = off), got "
+            f"{args.pipeline_stages}"
+        )
+    if args.pipeline_stages:
+        # PP rides the model axis (stages); the batch shards over data
+        # only, so seq-parallel (default 2) is overridden to 1. The TP
+        # degree must stay 1 — the model config must NOT get a
+        # model_axis; only the MESH carries the stage-sized axis
+        # (TP-within-PP needs the train.pp API with a dedicated stage
+        # axis).
+        if tp > 1:
+            raise SystemExit(
+                "--pipeline-stages uses the model axis for stages; drop "
+                "--model-parallel (TP-within-PP needs the train.pp API "
+                "with a dedicated stage axis)"
+            )
+        if sp > 1:
+            rank0_print(
+                f"pipeline run: overriding --seq-parallel {sp} -> 1 "
+                "(PP batches shard over data only)"
+            )
+        sp = 1
+    mesh_mp = args.pipeline_stages or tp
     n = jax.device_count()
-    if n % (sp * tp):
-        raise SystemExit(f"{n} devices not divisible by sp*tp={sp * tp}")
-    mesh = make_mesh(data_parallel=n // (sp * tp), seq_parallel=sp,
-                     model_parallel=tp)
+    if n % (sp * mesh_mp):
+        raise SystemExit(
+            f"{n} devices not divisible by sp*mp={sp * mesh_mp}"
+        )
+    mesh = make_mesh(data_parallel=n // (sp * mesh_mp), seq_parallel=sp,
+                     model_parallel=mesh_mp)
 
     # seq-sharded runs need a global (ring) attention; honor an explicit
     # ring variant from --attention, otherwise default to the Pallas-kernel
@@ -126,6 +153,8 @@ def main() -> None:
         num_workers=0 if args.tiny else 4,
         grad_clip_norm=args.grad_clip_norm,
         fsdp=args.fsdp,
+        pipeline_stages=args.pipeline_stages,
+        pp_microbatches=args.pp_microbatches,
     )
     trainer = LMTrainer(model_cfg, train_ds, val_ds, cfg, mesh=mesh,
                         suspend_watcher=SuspendWatcher())
