@@ -24,6 +24,7 @@ written on suspend (not periodic — same policy, SURVEY.md §5);
 from __future__ import annotations
 
 import os
+import re
 import threading
 from typing import Any, Optional
 
@@ -131,9 +132,7 @@ def load_checkpoint(path: str | os.PathLike, template: Any) -> Any:
 MANIFEST = "manifest.json"
 
 # shard-<token>-NNNNN.npz (current) or shard-NNNNN.npz (pre-r4 legacy)
-_SHARD_RE = __import__("re").compile(
-    r"^shard-(?:([0-9a-f]+)-)?(\d{5})\.npz$"
-)
+_SHARD_RE = re.compile(r"^shard-(?:([0-9a-f]+)-)?(\d{5})\.npz$")
 
 
 def _shard_name(token: str, pidx: int) -> str:

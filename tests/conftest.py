@@ -48,3 +48,20 @@ def assert_trees_equal(a, b, rtol=0, atol=0):
             np.asarray(jax.device_get(flat_b[str(path)])),
             rtol=rtol, atol=atol, err_msg=str(path),
         )
+
+
+from pytorch_distributed_tpu.utils.suspend import SuspendWatcher  # noqa: E402
+
+
+class FireAtStep(SuspendWatcher):
+    """Deterministic suspend injection shared by the trainer tests:
+    fires once the poll count reaches n."""
+
+    def __init__(self, n):
+        super().__init__(install_handlers=False)
+        self.n = n
+        self.calls = 0
+
+    def receive_suspend_command(self) -> bool:
+        self.calls += 1
+        return self.calls >= self.n or self._event.is_set()

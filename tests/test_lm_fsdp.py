@@ -157,19 +157,9 @@ def test_lm_fsdp_trainer_suspend_resume_bit_parity(tmp_path, devices8):
     suspend and resumed (sharded checkpoint of the MIXED spec tree —
     ZeRO shards + Megatron shards + replicated leaves) equals the
     uninterrupted run bit for bit."""
+    from conftest import FireAtStep
     from pytorch_distributed_tpu.data.tokens import SyntheticTokens
     from pytorch_distributed_tpu.train import LMTrainer, LMTrainerConfig
-    from pytorch_distributed_tpu.utils.suspend import SuspendWatcher
-
-    class FireAtStep(SuspendWatcher):
-        def __init__(self, n):
-            super().__init__(install_handlers=False)
-            self.n = n
-            self.calls = 0
-
-        def receive_suspend_command(self) -> bool:
-            self.calls += 1
-            return self.calls >= self.n or self._event.is_set()
 
     def trainer(save_dir, watcher=None):
         mesh = make_mesh(devices8, data_parallel=2, seq_parallel=2,

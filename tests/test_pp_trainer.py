@@ -13,18 +13,7 @@ from pytorch_distributed_tpu.data.tokens import SyntheticTokens
 from pytorch_distributed_tpu.models.transformer import tiny_config
 from pytorch_distributed_tpu.parallel import make_mesh
 from pytorch_distributed_tpu.train import LMTrainer, LMTrainerConfig
-from pytorch_distributed_tpu.utils.suspend import SuspendWatcher
-
-
-class FireAtStep(SuspendWatcher):
-    def __init__(self, n):
-        super().__init__(install_handlers=False)
-        self.n = n
-        self.calls = 0
-
-    def receive_suspend_command(self) -> bool:
-        self.calls += 1
-        return self.calls >= self.n or self._event.is_set()
+from conftest import FireAtStep  # noqa: E402
 
 
 def make_trainer(save_dir, devices8, stages=0, watcher=None, dropout=0.0,

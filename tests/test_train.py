@@ -18,7 +18,7 @@ from pytorch_distributed_tpu.data import SyntheticImageClassification
 from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
 from pytorch_distributed_tpu.parallel import make_mesh
 from pytorch_distributed_tpu.train import Trainer, TrainerConfig
-from pytorch_distributed_tpu.utils.suspend import SuspendWatcher
+from conftest import FireAtStep  # noqa: E402
 
 
 def tiny_model(**kw):
@@ -75,19 +75,6 @@ def test_validate_partial_batch_smaller_than_pad(tmp_path, devices8):
     trainer = make_trainer(tmp_path, devices8, val_size=35)  # 16+16+3
     out = trainer.validate()
     assert out["count"] == 40.0  # 16 + 16 + (3 wrapped to 8)
-
-
-class FireAtStep(SuspendWatcher):
-    """Deterministic injection: fires once the poll count reaches n."""
-
-    def __init__(self, n):
-        super().__init__(install_handlers=False)
-        self.n = n
-        self.calls = 0
-
-    def receive_suspend_command(self) -> bool:
-        self.calls += 1
-        return self.calls >= self.n or self._event.is_set()
 
 
 def test_suspend_resume_bit_parity(tmp_path, devices8):
