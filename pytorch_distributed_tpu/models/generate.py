@@ -384,9 +384,15 @@ class ContinuousBatcher:
 
     def __init__(self, config: TransformerConfig, params, n_slots: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 prefill_bucket: int = 128, seed: int = 0):
+                 prefill_bucket: int = 128, seed: int = 0,
+                 eos_id: Optional[int] = None):
         _validate_serving_config(config)
         _validate_sampling(config, temperature, top_k)
+        if eos_id is not None and not 0 <= eos_id < config.vocab_size:
+            raise ValueError(
+                f"eos_id {eos_id} outside [0, vocab_size={config.vocab_size})"
+            )
+        self.eos_id = eos_id
         self.config = config
         self.params = params
         self.n_slots = n_slots
@@ -473,7 +479,9 @@ class ContinuousBatcher:
 
     def step(self):
         """One decode tick for every active slot. Returns
-        ``[(slot, token)]`` for the tokens produced this tick."""
+        ``[(slot, token)]`` for the tokens produced this tick (an EOS
+        token is returned AND retires its slot immediately when
+        ``eos_id`` is set — the slot frees for the next submit)."""
         active_np = self.remaining > 0
         if not active_np.any():
             return []
@@ -488,6 +496,10 @@ class ContinuousBatcher:
         out = []
         toks = np.asarray(tokens)
         for slot in np.nonzero(active_np)[0]:
-            out.append((int(slot), int(toks[slot])))
-            self.remaining[slot] -= 1
+            token = int(toks[slot])
+            out.append((int(slot), token))
+            if self.eos_id is not None and token == self.eos_id:
+                self.remaining[slot] = 0  # early retirement
+            else:
+                self.remaining[slot] -= 1
         return out

@@ -112,3 +112,32 @@ def test_ragged_validations():
     with pytest.raises(ValueError, match="dense-attention only"):
         generate_ragged(cfg_ring, params, prompts, lengths,
                         jax.random.key(0), max_new_tokens=2)
+
+
+def test_continuous_batcher_eos_early_retirement():
+    """With eos_id set, a slot retires the moment it emits EOS — the
+    remaining budget is abandoned and the slot frees for the next
+    request. Forced by picking the greedy argmax of the first step as
+    the eos_id."""
+    cfg, params = setup()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, cfg.vocab_size, (9,)).astype(np.int32)
+    # find what greedy emits first, then declare THAT token the EOS
+    first = int(per_request_reference(cfg, params, [prompt], 1)[0][0])
+    batcher = ContinuousBatcher(cfg, params, n_slots=1, prefill_bucket=8,
+                                eos_id=first)
+    slot = batcher.submit(prompt, max_new_tokens=10)
+    events = batcher.step()
+    assert events == [(slot, first)]
+    assert batcher.remaining[slot] == 0  # retired after 1 of 10 tokens
+    assert batcher.free_slots() == [slot]
+    assert batcher.step() == []  # nothing active
+    # the freed slot admits a new request immediately
+    slot2 = batcher.submit(prompt, max_new_tokens=2)
+    assert slot2 == slot
+
+
+def test_batcher_eos_validation():
+    cfg, params = setup()
+    with pytest.raises(ValueError, match="eos_id"):
+        ContinuousBatcher(cfg, params, n_slots=1, eos_id=cfg.vocab_size)
