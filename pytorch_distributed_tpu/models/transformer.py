@@ -44,6 +44,15 @@ class TransformerConfig:
     attention: str = "dense"  # dense | blockwise | flash | ring | ring_flash
     block_size: int = 512  # kv block for blockwise attention
     seq_axis: str = SEQ_AXIS  # mesh axis for attention="ring"
+    # Grouped-query attention: K/V get num_kv_heads heads (must divide
+    # num_heads), each shared by a GROUP of num_heads/num_kv_heads query
+    # heads — the KV decode cache and the kv projection shrink by the
+    # group factor (the Llama-family serving-memory trade). None = MHA
+    # with the fused qkv projection (checkpoint layout unchanged); GQA
+    # uses separate "q"/"kv" projections. K/V repeat to full heads at
+    # compute, so every attention path (dense/flash/ring/...) is
+    # unchanged downstream.
+    num_kv_heads: Optional[int] = None
     # Ring shard layout: "contiguous" (shard i = tokens [i*L, (i+1)*L)) or
     # "zigzag" (shard i = chunks (i, 2s-1-i) — balances the causal ring's
     # critical path, halving the max per-rank block area at sp=8;
@@ -104,6 +113,22 @@ class TransformerConfig:
             raise ValueError(
                 f"num_heads {self.num_heads} not divisible by tp_size {self.tp_size}"
             )
+        if self.num_kv_heads is not None:
+            if self.num_kv_heads < 1:
+                raise ValueError(
+                    f"num_kv_heads must be >= 1, got {self.num_kv_heads}"
+                )
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError(
+                    f"num_heads {self.num_heads} not divisible by "
+                    f"num_kv_heads {self.num_kv_heads}"
+                )
+            if self.num_kv_heads % self.tp_size:
+                raise ValueError(
+                    f"num_kv_heads {self.num_kv_heads} not divisible by "
+                    f"tp_size {self.tp_size} (each TP rank needs whole KV "
+                    "heads)"
+                )
         if self.tp_size > 1 and self.model_axis is None:
             raise ValueError(
                 f"tp_size {self.tp_size} > 1 requires model_axis: without "
@@ -135,10 +160,25 @@ class Attention(nn.Module):
 
             x = tp_copy(x, cfg.model_axis)  # column-parallel qkv below
         heads_local = cfg.num_heads // cfg.tp_size
-        qkv = nn.DenseGeneral(
-            (3, heads_local, head_dim), dtype=cfg.dtype, name="qkv"
-        )(x)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H_loc, D]
+        if cfg.num_kv_heads is None:
+            qkv = nn.DenseGeneral(
+                (3, heads_local, head_dim), dtype=cfg.dtype, name="qkv"
+            )(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,L,H,D]
+            kv_group = 1
+        else:
+            # GQA: separate projections; K/V carry num_kv_heads heads —
+            # the cache below inherits the narrow head count (the serving
+            # memory win), and compute repeats to full heads afterwards.
+            kv_heads_local = cfg.num_kv_heads // cfg.tp_size
+            kv_group = heads_local // kv_heads_local
+            q = nn.DenseGeneral(
+                (heads_local, head_dim), dtype=cfg.dtype, name="q"
+            )(x)
+            kv = nn.DenseGeneral(
+                (2, kv_heads_local, head_dim), dtype=cfg.dtype, name="kv"
+            )(x)
+            k, v = kv[:, :, 0], kv[:, :, 1]  # [B, L, H_kv_loc, D]
 
         if self.decode or self.prefill:
             # KV cache. ``position_offset`` is the single source of
@@ -148,13 +188,14 @@ class Attention(nn.Module):
             # mode it may be a PER-REQUEST [B] vector (ragged serving:
             # each request writes its own cache slot).
             max_len = cfg.max_seq_len
+            kv_heads = k.shape[2]  # H_kv_local under GQA, H_local for MHA
             ck = self.variable(
                 "cache", "key",
-                lambda: jnp.zeros((b, max_len, heads_local, head_dim), cfg.dtype),
+                lambda: jnp.zeros((b, max_len, kv_heads, head_dim), cfg.dtype),
             )
             cv = self.variable(
                 "cache", "value",
-                lambda: jnp.zeros((b, max_len, heads_local, head_dim), cfg.dtype),
+                lambda: jnp.zeros((b, max_len, kv_heads, head_dim), cfg.dtype),
             )
             pos = jnp.asarray(position_offset, jnp.int32)
             if self.decode and pos.ndim == 1:
@@ -182,17 +223,39 @@ class Attention(nn.Module):
             pos = jnp.asarray(position_offset, jnp.int32)
             pos_b = pos if pos.ndim == 1 else jnp.full((b,), pos)
             scale = head_dim**-0.5
-            s = jnp.einsum(
-                "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
-                ck.value.astype(jnp.float32),
-            )  # [B, H, 1, max_len]
-            mask = (jnp.arange(cfg.max_seq_len)[None, None, None, :]
-                    <= pos_b[:, None, None, None])
-            s = jnp.where(mask, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            out = jnp.einsum(
-                "bhqk,bkhd->bqhd", p, cv.value.astype(jnp.float32)
-            ).astype(cfg.dtype)
+            if kv_group > 1:
+                # GQA decode: grouped einsum directly against the NARROW
+                # cache — no widened K/V tensor ever materializes, so the
+                # decode memory traffic (the bottleneck GQA targets)
+                # really is 1/group of MHA's. Query head qh maps to
+                # narrow head qh // group, matching the repeat layout
+                # the train path uses.
+                qg = (q.astype(jnp.float32) * scale).reshape(
+                    b, 1, kv_heads, kv_group, head_dim
+                )
+                s = jnp.einsum(
+                    "bqhgd,bkhd->bhgqk", qg,
+                    ck.value.astype(jnp.float32),
+                )  # [B, H_kv, G, 1, max_len]
+                mask = (jnp.arange(cfg.max_seq_len)[None, None, None, None]
+                        <= pos_b[:, None, None, None, None])
+                s = jnp.where(mask, s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum(
+                    "bhgqk,bkhd->bqhgd", p, cv.value.astype(jnp.float32)
+                ).reshape(b, 1, heads_local, head_dim).astype(cfg.dtype)
+            else:
+                s = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                    ck.value.astype(jnp.float32),
+                )  # [B, H, 1, max_len]
+                mask = (jnp.arange(cfg.max_seq_len)[None, None, None, :]
+                        <= pos_b[:, None, None, None])
+                s = jnp.where(mask, s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum(
+                    "bhqk,bkhd->bqhd", p, cv.value.astype(jnp.float32)
+                ).astype(cfg.dtype)
             out = nn.DenseGeneral(
                 e, axis=(-2, -1), use_bias=False, dtype=cfg.dtype, name="proj"
             )(out)
@@ -203,6 +266,14 @@ class Attention(nn.Module):
             return out
         # prefill falls through: one BATCHED causal forward over the prompt
         # (the cache write above is its only side effect)
+
+        if kv_group > 1:
+            # GQA: widen K/V to the full head count for the attention
+            # paths below — they all see plain MHA shapes (the cache
+            # above already stored the NARROW heads; this is compute-side
+            # only)
+            k = jnp.repeat(k, kv_group, axis=2)
+            v = jnp.repeat(v, kv_group, axis=2)
 
         if cfg.attention == "ring":
             from pytorch_distributed_tpu.parallel.sequence import ring_attention

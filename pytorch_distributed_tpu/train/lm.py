@@ -95,6 +95,11 @@ def create_lm_state(
 TRANSFORMER_TP_RULES = (
     (r"attn/qkv/kernel", P(None, None, MODEL_AXIS, None)),  # [E,3,H,D] → H
     (r"attn/qkv/bias", P(None, MODEL_AXIS, None)),  # [3,H,D] → H
+    # GQA's split projections (models/transformer.py num_kv_heads)
+    (r"attn/q/kernel", P(None, MODEL_AXIS, None)),  # [E,H,D] → H
+    (r"attn/q/bias", P(MODEL_AXIS, None)),  # [H,D]
+    (r"attn/kv/kernel", P(None, None, MODEL_AXIS, None)),  # [E,2,Hkv,D]
+    (r"attn/kv/bias", P(None, MODEL_AXIS, None)),  # [2,Hkv,D]
     (r"attn/proj/kernel", P(MODEL_AXIS, None, None)),  # [H,D,E] → H
     (r"mlp_up/kernel", P(None, MODEL_AXIS)),  # [E,4E] → 4E
     (r"mlp_up/bias", P(MODEL_AXIS,)),  # [4E]

@@ -173,6 +173,11 @@ def parse_lm_args(description: str) -> argparse.Namespace:
     p.add_argument("--vocab-size", type=int, default=32000)
     p.add_argument("--layers", type=int, default=12)
     p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="grouped-query attention: K/V head count (must "
+                        "divide --heads; default = MHA). Shrinks the "
+                        "decode KV cache and kv projection by the group "
+                        "factor")
     p.add_argument("--embed-dim", type=int, default=768)
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--lr", type=float, default=3e-4)

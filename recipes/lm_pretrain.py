@@ -133,6 +133,7 @@ def main() -> None:
             vocab_size=vocab,
             num_layers=args.layers,
             num_heads=args.heads,
+            num_kv_heads=args.kv_heads,
             embed_dim=args.embed_dim,
             max_seq_len=seq_len,
             dropout=args.dropout,
