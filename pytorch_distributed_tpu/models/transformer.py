@@ -53,6 +53,15 @@ class TransformerConfig:
     # compute, so every attention path (dense/flash/ring/...) is
     # unchanged downstream.
     num_kv_heads: Optional[int] = None
+    # Position encoding: "learned" (GPT-2-style wpe table, the default)
+    # or "rope" (rotary embeddings applied to q/k INSIDE attention — no
+    # wpe parameter, unbounded-length friendly). Rotation happens before
+    # any attention path runs, with each token's ABSOLUTE position baked
+    # in — so ring/zigzag/flash/decode all inherit it unchanged (K is
+    # rotated before it travels the ring, and the KV cache stores
+    # rotated keys).
+    pos_embedding: str = "learned"
+    rope_theta: float = 10000.0
     # Ring shard layout: "contiguous" (shard i = tokens [i*L, (i+1)*L)) or
     # "zigzag" (shard i = chunks (i, 2s-1-i) — balances the causal ring's
     # critical path, halving the max per-rank block area at sp=8;
@@ -113,6 +122,21 @@ class TransformerConfig:
             raise ValueError(
                 f"num_heads {self.num_heads} not divisible by tp_size {self.tp_size}"
             )
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embedding {self.pos_embedding!r} must be 'learned' "
+                "or 'rope'"
+            )
+        if self.pos_embedding == "rope" and (self.embed_dim
+                                             // self.num_heads) % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got "
+                f"{self.embed_dim // self.num_heads}"
+            )
+        if self.rope_theta <= 0.0:
+            raise ValueError(
+                f"rope_theta must be > 0, got {self.rope_theta}"
+            )
         if self.num_kv_heads is not None:
             if self.num_kv_heads < 1:
                 raise ValueError(
@@ -144,6 +168,20 @@ class TransformerConfig:
             raise ValueError(f"dropout must be in [0, 1), got {self.dropout}")
 
 
+def _rope_rotate(x, positions, theta: float):
+    """Rotary embedding on ``x`` [B, L, H, D] at absolute ``positions``
+    ([1, L] shared or [B, L] per-request), interleaved-pair convention.
+    fp32 trig regardless of compute dtype."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = positions.astype(jnp.float32)[..., None] * inv  # [B?, L, D/2]
+    cos = jnp.cos(ang)[:, :, None, :]  # [B?, L, 1, D/2] broadcasts over H
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., ::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
 class Attention(nn.Module):
     config: TransformerConfig
     deterministic: bool = True
@@ -151,7 +189,7 @@ class Attention(nn.Module):
     prefill: bool = False
 
     @nn.compact
-    def __call__(self, x, position_offset):
+    def __call__(self, x, position_offset, positions=None):
         cfg = self.config
         b, l, e = x.shape
         head_dim = e // cfg.num_heads
@@ -179,6 +217,24 @@ class Attention(nn.Module):
                 (2, kv_heads_local, head_dim), dtype=cfg.dtype, name="kv"
             )(x)
             k, v = kv[:, :, 0], kv[:, :, 1]  # [B, L, H_kv_loc, D]
+
+        if cfg.pos_embedding == "rope":
+            # Rotate BEFORE the cache write and before any attention path
+            # runs: absolute positions are baked into q/k, so the ring
+            # variants ship pre-rotated keys and the cache stores rotated
+            # keys — downstream stays position-agnostic. The positions
+            # are RESOLVED by the caller (TransformerLM / PPStage) — one
+            # source of truth, never re-derived here where it could drift
+            # from the wpe/cache-write/mask convention.
+            if positions is None:
+                raise ValueError(
+                    "pos_embedding='rope' needs the resolved positions= "
+                    "array ([L] shared or [B, L] per-request); "
+                    "TransformerLM and train.pp.PPStage provide it"
+                )
+            rpos = positions[None] if positions.ndim == 1 else positions
+            q = _rope_rotate(q, rpos, cfg.rope_theta)
+            k = _rope_rotate(k, rpos, cfg.rope_theta)
 
         if self.decode or self.prefill:
             # KV cache. ``position_offset`` is the single source of
@@ -375,13 +431,13 @@ class Block(nn.Module):
     prefill: bool = False
 
     @nn.compact
-    def __call__(self, x, position_offset):
+    def __call__(self, x, position_offset, positions=None):
         cfg = self.config
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + Attention(
             cfg, deterministic=self.deterministic, decode=self.decode,
             prefill=self.prefill, name="attn",
-        )(h, position_offset)
+        )(h, position_offset, positions)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.use_moe:
             from pytorch_distributed_tpu.models.moe import MoEMLP
@@ -456,23 +512,30 @@ class TransformerLM(nn.Module):
                 "it, and shard batches with shard_lm_batch(..., "
                 "layout='zigzag')."
             )
-        wpe = nn.Embed(cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype,
-                       name="wpe")
         off = jnp.asarray(position_offset, jnp.int32)
+        if off.ndim == 1 and (not decode or tokens.shape[1] != 1):
+            raise ValueError(
+                "a [B] position_offset vector is the ragged DECODE "
+                "convention (one token per request); prefill/training "
+                "use a scalar offset or positions="
+            )
+        # ONE resolution of per-token absolute positions, feeding BOTH
+        # the learned wpe lookup and (passed down to every block) the
+        # rope rotation — the two can never disagree. Shapes: [L] shared,
+        # [B, L] per-request, or [B, 1] ragged decode.
         if positions is not None:
-            x = x + wpe(positions)
+            pos = positions
         elif off.ndim == 1:
-            # per-request decode positions [B] (ragged serving): one token
-            # per row, each at its own absolute position
-            if not decode or tokens.shape[1] != 1:
-                raise ValueError(
-                    "a [B] position_offset vector is the ragged DECODE "
-                    "convention (one token per request); prefill/training "
-                    "use a scalar offset or positions="
-                )
-            x = x + wpe(off)[:, None, :]
+            # per-request decode positions [B] (ragged serving): one
+            # token per row, each at its own absolute position
+            pos = off[:, None]
         else:
-            x = x + wpe(off + jnp.arange(tokens.shape[1]))
+            pos = off + jnp.arange(tokens.shape[1])
+        if cfg.pos_embedding == "learned":
+            x = x + nn.Embed(
+                cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype, name="wpe"
+            )(pos)
+        # rope: no wpe table — Attention rotates q/k from the same pos
         if cfg.dropout and not inference:
             x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
         for i in range(cfg.num_layers):
@@ -480,7 +543,7 @@ class TransformerLM(nn.Module):
             x = Block(
                 cfg, use_moe=use_moe, deterministic=deterministic,
                 decode=decode, prefill=prefill, name=f"block{i}",
-            )(x, position_offset)
+            )(x, position_offset, pos)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -73,6 +73,10 @@ class PPEmbed(nn.Module):
     def __call__(self, tokens):
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype, name="wte")(tokens)
+        if cfg.pos_embedding == "rope":
+            # rotation happens inside each stage's Attention (positions
+            # are arange(l) — PP batches are never seq-sharded)
+            return x
         pos = jnp.arange(tokens.shape[1])
         return x + nn.Embed(
             cfg.max_seq_len, cfg.embed_dim, dtype=cfg.dtype, name="wpe"
@@ -95,6 +99,9 @@ class PPStage(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
+        # resolved absolute positions for rope (PP batches are never
+        # seq-sharded, so positions are simply arange)
+        pos = jnp.arange(x.shape[1])
         for j in range(self.layers_per_stage):
             use_moe = bool(cfg.n_experts) and (
                 j % cfg.moe_every == cfg.moe_every - 1
@@ -102,7 +109,7 @@ class PPStage(nn.Module):
             x = Block(
                 cfg, use_moe=use_moe, deterministic=self.deterministic,
                 name=f"layer{j}",
-            )(x, 0)
+            )(x, 0, pos)
         return x
 
 

@@ -178,6 +178,10 @@ def parse_lm_args(description: str) -> argparse.Namespace:
                         "divide --heads; default = MHA). Shrinks the "
                         "decode KV cache and kv projection by the group "
                         "factor")
+    p.add_argument("--pos-embedding", default="learned",
+                   choices=["learned", "rope"],
+                   help="position encoding: GPT-2-style learned wpe table "
+                        "or rotary (q/k rotation in attention, no table)")
     p.add_argument("--embed-dim", type=int, default=768)
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--lr", type=float, default=3e-4)

@@ -134,6 +134,7 @@ def main() -> None:
             num_layers=args.layers,
             num_heads=args.heads,
             num_kv_heads=args.kv_heads,
+            pos_embedding=args.pos_embedding,
             embed_dim=args.embed_dim,
             max_seq_len=seq_len,
             dropout=args.dropout,
