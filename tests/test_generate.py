@@ -154,3 +154,34 @@ def test_generate_tp_validations(devices8):
     bad = dataclasses.replace(cfg, model_axis="model", tp_size=2)
     with pytest.raises(ValueError, match="tp_size"):
         generate_tp(mesh1, bad, params, tokens, jax.random.key(0))
+
+
+def test_generate_tp_with_gqa_and_rope(devices8):
+    """TP decoding with the round-4 model features together: GQA (kv
+    heads Megatron-sharded, narrow sharded cache) + RoPE (rotation on the
+    sharded q/k) emit exactly the replicated path's tokens."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.models.generate import generate_tp
+    from pytorch_distributed_tpu.models.transformer import (
+        TransformerLM,
+        tiny_config,
+    )
+    from pytorch_distributed_tpu.parallel import make_mesh
+
+    cfg = tiny_config(num_heads=4, embed_dim=32, num_kv_heads=2,
+                      pos_embedding="rope", max_seq_len=64,
+                      attention="dense")
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(1, 128, (2, 7)), jnp.int32
+    )
+    tp_cfg = dataclasses.replace(cfg, model_axis="model", tp_size=2)
+    mesh = make_mesh(devices8, data_parallel=4, model_parallel=2)
+    ref = generate(cfg, params, tokens, jax.random.key(5),
+                   max_new_tokens=8, temperature=0.0)
+    got = generate_tp(mesh, tp_cfg, params, tokens, jax.random.key(5),
+                      max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
