@@ -61,15 +61,23 @@ def lm_collate(samples) -> dict:
 
 def shard_lm_batch(mesh, batch, data_axis=mesh_lib.DATA_AXIS,
                    seq_axis=mesh_lib.SEQ_AXIS, layout="contiguous"):
-    """Host-local [B, L] arrays → global arrays sharded P(data, seq).
+    """Host-local [B, L] arrays → global arrays sharded P(data, seq) —
+    or P(data) alone on meshes without a seq axis (the PP×TP
+    (data, stage, model) convention).
 
     ``layout="zigzag"``: every per-token array is host-permuted with
     ``parallel.sequence.zigzag_shard`` first, so the contiguous placement
     delivers chunk pair (r, 2s-1-r) to seq-shard r — tokens, labels, and
     weights permute identically and stay aligned; the LM steps feed wpe
     the matching position vector (train/lm.py ``_shard_positions``)."""
-    sharding = NamedSharding(mesh, P(data_axis, seq_axis))
-    s = mesh.shape[seq_axis]
+    if seq_axis in mesh.shape:
+        sharding = NamedSharding(mesh, P(data_axis, seq_axis))
+        s = mesh.shape[seq_axis]
+    else:
+        # PP×TP meshes carry (data, stage, model) — no seq axis; batches
+        # shard over data only
+        sharding = NamedSharding(mesh, P(data_axis))
+        s = 1
     if layout == "zigzag" and s > 1:
         from pytorch_distributed_tpu.parallel.sequence import zigzag_shard
 
@@ -109,11 +117,13 @@ class LMTrainerConfig:
     # forward and reduce-scatters their grads (train/lm.py round 4 —
     # composes with TP, EP, SP, clipping, and the sharded checkpointer).
     fsdp: bool = False
-    # Pipeline parallelism: > 0 trains through the GPipe executor with
-    # this many stages on the mesh's model axis (train/pp.py). The batch
-    # shards over data only (seq axis must be 1); TP-within-PP and FSDP
-    # need the lower-level API (a custom stage axis) and are rejected
-    # here. pp_microbatches follows BENCH_PP.md's measured default.
+    # Pipeline parallelism: > 0 trains through the GPipe executor
+    # (train/pp.py). Stages ride the mesh's model axis on the standard
+    # (data, seq, model) mesh, or a dedicated "stage" axis on a
+    # (data, stage, model) mesh — the latter composes TP-within-PP
+    # (model_axis/tp_size set, Megatron collectives inside each stage).
+    # The batch shards over data only (seq axis must be 1); FSDP is
+    # rejected. pp_microbatches follows BENCH_PP.md's measured default.
     pipeline_stages: int = 0
     pp_microbatches: int = 8
 
@@ -179,23 +189,55 @@ class LMTrainer(SuspendableTrainer):
             )
 
             s = config.pipeline_stages
-            if self.mesh.shape.get("model", 1) != s:
+            # Two mesh conventions:
+            # - plain PP: the standard (data, seq, model) mesh with the
+            #   MODEL axis carrying the stages (model_config.model_axis
+            #   must be None);
+            # - TP-within-PP: a (data, stage, model) mesh — a dedicated
+            #   "stage" axis for the pipeline ring, the model axis for
+            #   the Megatron collectives (model_config.model_axis set).
+            if "stage" in self.mesh.shape:
+                stage_axis = "stage"
+                if model_config.model_axis is not None and (
+                    self.mesh.shape.get(model_config.model_axis, 1)
+                    != model_config.tp_size
+                ):
+                    raise ValueError(
+                        f"mesh {model_config.model_axis!r} size "
+                        f"{self.mesh.shape.get(model_config.model_axis)} "
+                        f"!= tp_size {model_config.tp_size}"
+                    )
+                if (model_config.model_axis is None
+                        and self.mesh.shape.get("model", 1) > 1):
+                    raise ValueError(
+                        "the mesh carries a model axis of size "
+                        f"{self.mesh.shape['model']} but the model config "
+                        "has no model_axis — every chip on it would do "
+                        "duplicate work; set model_axis/tp_size or size "
+                        "the axis to 1"
+                    )
+            else:
+                stage_axis = "model"
+                if model_config.model_axis is not None:
+                    raise ValueError(
+                        "TP-within-PP needs a dedicated stage axis — "
+                        "build the mesh with axis_names=('data', 'stage', "
+                        "'model') (stage size = pipeline_stages, model "
+                        "size = tp_size); on the standard mesh the "
+                        "trainer runs stages on the model axis"
+                    )
+            if self.mesh.shape.get(stage_axis, 1) != s:
                 raise ValueError(
-                    f"pipeline_stages={s} needs the mesh's model axis to "
-                    f"carry the stages (got {self.mesh.shape.get('model')}); "
-                    "build the mesh with model_parallel == pipeline_stages"
+                    f"pipeline_stages={s} needs the mesh's {stage_axis!r} "
+                    f"axis to carry the stages "
+                    f"(got {self.mesh.shape.get(stage_axis)}); build the "
+                    "mesh with that axis sized to pipeline_stages"
                 )
             if self.mesh.shape.get("seq", 1) > 1:
                 raise ValueError(
                     "the PP trainer shards batches over data only; use "
                     "seq_parallel=1 (ring attention cannot run inside a "
                     "pipeline stage)"
-                )
-            if model_config.model_axis is not None:
-                raise ValueError(
-                    "TP-within-PP needs a dedicated stage axis — use "
-                    "train.pp directly with a (data, stage, model) mesh; "
-                    "the trainer runs stages on the model axis"
                 )
             if config.fsdp:
                 raise ValueError(
@@ -206,7 +248,7 @@ class LMTrainer(SuspendableTrainer):
                 model_config, s, tx, jax.random.key(config.seed)
             )
             self.state, self.state_specs = shard_pp_state(
-                self.mesh, state, config=model_config
+                self.mesh, state, axis=stage_axis, config=model_config
             )
             # microbatches divide the PER-DATA-SHARD batch, which is
             # config.batch_size by definition; clamp for small runs
@@ -226,12 +268,14 @@ class LMTrainer(SuspendableTrainer):
             self.train_step = make_pp_lm_train_step(
                 self.mesh, model_config, self.state_specs,
                 n_microbatches=mb,
+                axis=stage_axis,
                 dropout_seed=config.seed,
                 grad_clip_norm=config.grad_clip_norm,
             )
             self.eval_step = make_pp_lm_eval_step(
                 self.mesh, model_config, self.state_specs,
                 n_microbatches=mb,
+                axis=stage_axis,
             )
         else:
             state = create_lm_state(
