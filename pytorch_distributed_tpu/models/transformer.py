@@ -489,7 +489,8 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, position_offset: jax.Array | int = 0,
                  train: bool = True, decode: bool = False,
-                 prefill: bool = False, positions: jax.Array | None = None):
+                 prefill: bool = False, positions: jax.Array | None = None,
+                 return_hidden: bool = False):
         cfg = self.config
         # Dropout is active only when train=True AND an rng is provided
         # (apply(..., rngs={"dropout": key}) — train/lm.py derives the key
@@ -545,8 +546,21 @@ class TransformerLM(nn.Module):
                 decode=decode, prefill=prefill, name=f"block{i}",
             )(x, position_offset, pos)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        head = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
+        )
+        if return_hidden:
+            # Fused-CE path (ops/fused_ce.py): the caller streams the
+            # lm_head matmul into a blockwise logsumexp using
+            # params["lm_head"]["kernel"] directly — the full [B, L, V]
+            # fp32 logits never materialize. CAUTION: flax creates params
+            # only for CALLED submodules, so init must always take the
+            # logits path below (it does: create_lm_state applies with the
+            # default return_hidden=False); apply-time skipping merely
+            # leaves the existing lm_head params unused, which flax
+            # tolerates — checkpoint layout identical either way.
+            return x
+        return head(x).astype(jnp.float32)
 
 
 def tiny_config(**overrides) -> TransformerConfig:

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pytorch_distributed_tpu.ops.fused_ce import fused_linear_cross_entropy
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.optim import (
     clip_grads_by_global_norm,
@@ -348,6 +349,27 @@ def check_seq_parallel_attention(mesh: Mesh, config, seq_axis: str = SEQ_AXIS):
         )
 
 
+def _lm_loss_sum(apply_out, params, batch, config, use_fused, block_n):
+    """Weighted CE sum for one step's model output — the ONE loss tail
+    both the train and eval steps use. ``apply_out`` is post-ln_f hidden
+    states (fused path) or full logits (``use_fused=False``)."""
+    if use_fused:
+        return fused_linear_cross_entropy(
+            apply_out,
+            params["lm_head"]["kernel"],
+            batch["labels"],
+            batch["weights"],
+            block_n=block_n,
+            compute_dtype=config.dtype,
+        )
+    per_tok = cross_entropy_loss(
+        apply_out.reshape(-1, apply_out.shape[-1]),
+        batch["labels"].reshape(-1),
+        reduction="none",
+    )
+    return jnp.sum(per_tok * batch["weights"].reshape(-1))
+
+
 def make_lm_train_step(
     mesh: Mesh,
     data_axis: str = DATA_AXIS,
@@ -357,6 +379,8 @@ def make_lm_train_step(
     dropout_seed: int = 0,
     grad_clip_norm: float = 0.0,
     fsdp: bool = False,
+    fused_ce: bool = True,
+    fused_ce_block_n: int = 1024,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -376,10 +400,19 @@ def make_lm_train_step(
     this shard's data/seq coordinates) — a resumed run reproduces the exact
     masks of an uninterrupted one, and model-axis replicas (which hold
     replicated activations at every dropout site) share one mask.
+
+    ``fused_ce`` (default, requires ``config``): the loss tail runs
+    ``ops.fused_ce.fused_linear_cross_entropy`` — the lm_head matmul is
+    streamed blockwise into the logsumexp, so the fp32 ``[B, L, V]``
+    logits tensor never exists in HBM (the r4 memory wall at bs8/L4096).
+    Numerically it accumulates logits in fp32 where the unfused path
+    materialized bf16 — equal-or-better. ``fused_ce=False`` or
+    ``config=None`` keeps the materialized-logits path.
     """
     if config is not None:
         check_seq_parallel_attention(mesh, config, seq_axis)
     use_dropout = config is not None and getattr(config, "dropout", 0.0) > 0.0
+    use_fused = fused_ce and config is not None
     axes = (data_axis, seq_axis)
     if fsdp and state_specs is None:
         raise ValueError(
@@ -429,24 +462,23 @@ def make_lm_train_step(
             model_params = state.params
 
         def loss_fn(params):
-            logits, mutated = state.apply_fn(
+            hidden_or_logits, mutated = state.apply_fn(
                 {"params": params},
                 batch["tokens"],
                 position_offset=offset,
                 positions=positions,
                 mutable=["aux_loss", "moe_stats"],
                 rngs=rngs,
+                return_hidden=use_fused,
             )
-            per_tok = cross_entropy_loss(
-                logits.reshape(-1, logits.shape[-1]),
-                batch["labels"].reshape(-1),
-                reduction="none",
+            loss_sum = _lm_loss_sum(
+                hidden_or_logits, params, batch, config, use_fused,
+                fused_ce_block_n,
             )
-            w = batch["weights"].reshape(-1)
             # This device's share of the global mean loss; sowed auxiliary
             # losses (MoE load balancing, pre-weighted) enter as their
             # across-shards mean.
-            local = jnp.sum(per_tok * w) / jnp.maximum(global_count, 1.0)
+            local = loss_sum / jnp.maximum(global_count, 1.0)
             for leaf in jax.tree.leaves(mutated.get("aux_loss", {})):
                 local = local + leaf / n_shards
             return local, mutated
@@ -535,6 +567,8 @@ def make_lm_eval_step(
     state_specs: Optional[TrainState] = None,
     config=None,
     fsdp: bool = False,
+    fused_ce: bool = True,
+    fused_ce_block_n: int = 1024,
 ) -> Callable[[TrainState, dict, dict], dict]:
     """Compiled evaluation step: ``eval_step(state, batch, acc) -> acc``.
 
@@ -561,6 +595,7 @@ def make_lm_eval_step(
     if config is not None:
         check_seq_parallel_attention(mesh, config, seq_axis)
     axes = (data_axis, seq_axis)
+    use_fused = fused_ce and config is not None
     eval_apply = None
     if config is not None and getattr(config, "n_experts", 0):
         import dataclasses
@@ -594,23 +629,21 @@ def make_lm_eval_step(
             )
         else:
             model_params = state.params
-        logits = apply_fn(
+        out = apply_fn(
             {"params": model_params},
             batch["tokens"],
             position_offset=offset,
             positions=positions,
             train=False,
+            return_hidden=use_fused,
         )
-        per_tok = cross_entropy_loss(
-            logits.reshape(-1, logits.shape[-1]),
-            batch["labels"].reshape(-1),
-            reduction="none",
+        loss_sum = _lm_loss_sum(
+            out, model_params, batch, config, use_fused, fused_ce_block_n
         )
-        w = batch["weights"].reshape(-1)
         return {
-            "loss_sum": acc["loss_sum"]
-            + jax.lax.psum(jnp.sum(per_tok * w), axes),
-            "tokens": acc["tokens"] + jax.lax.psum(jnp.sum(w), axes),
+            "loss_sum": acc["loss_sum"] + jax.lax.psum(loss_sum, axes),
+            "tokens": acc["tokens"]
+            + jax.lax.psum(jnp.sum(batch["weights"]), axes),
         }
 
     state_spec = state_specs if state_specs is not None else P()

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_tpu.models.transformer import Block, TransformerConfig
+from pytorch_distributed_tpu.ops.fused_ce import fused_linear_cross_entropy
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.optim import (
     clip_grads_by_global_norm,
@@ -117,13 +118,18 @@ class PPHead(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, return_hidden: bool = False):
         cfg = self.config
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        logits = nn.Dense(
+        head = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
-        )(x)
-        return logits.astype(jnp.float32)
+        )
+        if return_hidden:
+            # fused-CE path: the caller streams the lm_head matmul into
+            # the blockwise CE with params["head"]["lm_head"]["kernel"]
+            # (ops/fused_ce.py) — same contract as TransformerLM.
+            return x
+        return head(x).astype(jnp.float32)
 
 
 def create_pp_lm_state(
@@ -294,7 +300,8 @@ def pp_dropout_key(base_key, stage_idx, mb_idx):
 
 
 def _pp_loss(config, lps, params, batch, n_microbatches, axis,
-             dropout_key=None):
+             dropout_key=None, fused_ce: bool = True,
+             fused_ce_block_n: int = 1024):
     """Stage-local CE sum over this shard's pipeline output (real only on
     the last stage; the caller masks) plus this stage's REAL-tick MoE aux
     losses."""
@@ -326,14 +333,33 @@ def _pp_loss(config, lps, params, batch, n_microbatches, axis,
 
     outs, aux = gpipe(stage_fn, my_stage, mb, axis=axis, has_aux=True)
     outs = outs.reshape(b, l, x.shape[-1])
-    logits = PPHead(config).apply({"params": params["head"]}, outs)
+    return _head_loss_sum(config, params["head"], outs, batch,
+                          fused_ce, fused_ce_block_n), aux
+
+
+def _head_loss_sum(config, head_params, outs, batch, fused_ce,
+                   fused_ce_block_n: int = 1024):
+    """ln_f + lm_head + weighted CE sum — fused (blockwise, no
+    materialized logits) or via the full-logits reference path."""
+    if fused_ce:
+        hidden = PPHead(config).apply(
+            {"params": head_params}, outs, return_hidden=True
+        )
+        return fused_linear_cross_entropy(
+            hidden,
+            head_params["lm_head"]["kernel"],
+            batch["labels"],
+            batch["weights"],
+            block_n=fused_ce_block_n,
+            compute_dtype=config.dtype,
+        )
+    logits = PPHead(config).apply({"params": head_params}, outs)
     per_tok = cross_entropy_loss(
         logits.reshape(-1, logits.shape[-1]),
         batch["labels"].reshape(-1),
         reduction="none",
     )
-    w = batch["weights"].reshape(-1)
-    return jnp.sum(per_tok * w), aux
+    return jnp.sum(per_tok * batch["weights"].reshape(-1))
 
 
 def make_pp_lm_train_step(
@@ -345,6 +371,8 @@ def make_pp_lm_train_step(
     axis: str = MODEL_AXIS,
     dropout_seed: int = 0,
     grad_clip_norm: float = 0.0,
+    fused_ce: bool = True,
+    fused_ce_block_n: int = 1024,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Compiled PP train step over a (data, stage[, model]) mesh.
 
@@ -453,7 +481,8 @@ def make_pp_lm_train_step(
         def loss_fn(params):
             local_sum, aux = _pp_loss(
                 config, lps, params, batch, n_microbatches, axis,
-                dropout_key=dropout_key,
+                dropout_key=dropout_key, fused_ce=fused_ce,
+                fused_ce_block_n=fused_ce_block_n,
             )
             # Mask LOCALLY — no psum inside the differentiated function (a
             # param-dependent psum transposes to another psum and scales
@@ -534,6 +563,8 @@ def make_pp_lm_eval_step(
     n_microbatches: int = 8,
     data_axis: str = DATA_AXIS,
     axis: str = MODEL_AXIS,
+    fused_ce: bool = True,
+    fused_ce_block_n: int = 1024,
 ) -> Callable[[TrainState, dict, dict], dict]:
     """Validation under the pipeline: the same gpipe schedule forward-only
     (dropout off), loss summed on the last stage and psum'd global —
@@ -550,7 +581,8 @@ def make_pp_lm_eval_step(
     def _local_eval(state: TrainState, batch: dict, acc: dict):
         local_sum, _ = _pp_loss(
             config, lps, state.params, batch, n_microbatches, axis,
-            dropout_key=None,
+            dropout_key=None, fused_ce=fused_ce,
+            fused_ce_block_n=fused_ce_block_n,
         )
         my_stage = jax.lax.axis_index(axis)
         n_stages_rt = jax.lax.psum(1, axis)
@@ -581,6 +613,8 @@ def make_pp_reference_step(
     tx,
     n_microbatches: int = 1,
     dropout_seed: int = 0,
+    fused_ce: bool = True,
+    fused_ce_block_n: int = 1024,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Sequential single-device step over the SAME stacked params — the
     golden reference the pipelined step must match bit-for-bit (up to fp
@@ -625,15 +659,11 @@ def make_pp_reference_step(
                         aux_total = aux_total + leaf
                 outs.append(act)
             x = jnp.concatenate(outs, axis=0)
-            logits = PPHead(config).apply({"params": params["head"]}, x)
-            per_tok = cross_entropy_loss(
-                logits.reshape(-1, logits.shape[-1]),
-                batch["labels"].reshape(-1),
-                reduction="none",
+            loss_sum = _head_loss_sum(
+                config, params["head"], x, batch, fused_ce,
+                fused_ce_block_n,
             )
-            ce = jnp.sum(per_tok * batch["weights"].reshape(-1)) / jnp.maximum(
-                count, 1.0
-            )
+            ce = loss_sum / jnp.maximum(count, 1.0)
             return ce + aux_total / n_microbatches
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
