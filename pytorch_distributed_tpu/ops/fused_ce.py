@@ -178,7 +178,7 @@ def fused_linear_cross_entropy(
     labels: jax.Array,
     weights: jax.Array,
     *,
-    block_n: int = 1024,
+    block_n: int = 512,
     compute_dtype=jnp.bfloat16,
     vocab_axis: Optional[str] = None,
 ) -> jax.Array:

@@ -380,7 +380,7 @@ def make_lm_train_step(
     grad_clip_norm: float = 0.0,
     fsdp: bool = False,
     fused_ce: bool = True,
-    fused_ce_block_n: int = 1024,
+    fused_ce_block_n: int = 512,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -568,7 +568,7 @@ def make_lm_eval_step(
     config=None,
     fsdp: bool = False,
     fused_ce: bool = True,
-    fused_ce_block_n: int = 1024,
+    fused_ce_block_n: int = 512,
 ) -> Callable[[TrainState, dict, dict], dict]:
     """Compiled evaluation step: ``eval_step(state, batch, acc) -> acc``.
 

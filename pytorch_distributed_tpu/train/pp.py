@@ -301,7 +301,7 @@ def pp_dropout_key(base_key, stage_idx, mb_idx):
 
 def _pp_loss(config, lps, params, batch, n_microbatches, axis,
              dropout_key=None, fused_ce: bool = True,
-             fused_ce_block_n: int = 1024):
+             fused_ce_block_n: int = 512):
     """Stage-local CE sum over this shard's pipeline output (real only on
     the last stage; the caller masks) plus this stage's REAL-tick MoE aux
     losses."""
@@ -338,7 +338,7 @@ def _pp_loss(config, lps, params, batch, n_microbatches, axis,
 
 
 def _head_loss_sum(config, head_params, outs, batch, fused_ce,
-                   fused_ce_block_n: int = 1024):
+                   fused_ce_block_n: int = 512):
     """ln_f + lm_head + weighted CE sum — fused (blockwise, no
     materialized logits) or via the full-logits reference path."""
     if fused_ce:
@@ -372,7 +372,7 @@ def make_pp_lm_train_step(
     dropout_seed: int = 0,
     grad_clip_norm: float = 0.0,
     fused_ce: bool = True,
-    fused_ce_block_n: int = 1024,
+    fused_ce_block_n: int = 512,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Compiled PP train step over a (data, stage[, model]) mesh.
 
@@ -564,7 +564,7 @@ def make_pp_lm_eval_step(
     data_axis: str = DATA_AXIS,
     axis: str = MODEL_AXIS,
     fused_ce: bool = True,
-    fused_ce_block_n: int = 1024,
+    fused_ce_block_n: int = 512,
 ) -> Callable[[TrainState, dict, dict], dict]:
     """Validation under the pipeline: the same gpipe schedule forward-only
     (dropout off), loss summed on the last stage and psum'd global —
@@ -614,7 +614,7 @@ def make_pp_reference_step(
     n_microbatches: int = 1,
     dropout_seed: int = 0,
     fused_ce: bool = True,
-    fused_ce_block_n: int = 1024,
+    fused_ce_block_n: int = 512,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Sequential single-device step over the SAME stacked params — the
     golden reference the pipelined step must match bit-for-bit (up to fp

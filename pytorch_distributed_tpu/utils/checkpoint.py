@@ -266,7 +266,7 @@ class _ShardedSave:
     """
 
     def __init__(self, dirpath: str | os.PathLike, payload: Any,
-                 arena: Optional[_Arena] = None):
+                 arena: Optional[_Arena] = None, snapshot: bool = True):
         self.dirpath = os.fspath(dirpath)
         if os.path.isfile(self.dirpath):
             try:  # a legacy single-file checkpoint of the same name; every
@@ -361,10 +361,26 @@ class _ShardedSave:
         self.manifest = manifest
 
         # Pass 2 — SNAPSHOT: one bulk copy of every local block into a
-        # single (reusable) arena. The copy is mandatory — the live
-        # buffers are donated into the next train step, and on the CPU
-        # backend ``np.asarray(jax_array)`` is a zero-copy view of them.
-        # See ``_Arena`` for why one buffer instead of per-leaf copies.
+        # single (reusable) arena. The copy is mandatory for the
+        # NON-BLOCKING path — the live buffers are donated into the next
+        # train step, and on the CPU backend ``np.asarray(jax_array)`` is
+        # a zero-copy view of them. See ``_Arena`` for why one buffer
+        # instead of per-leaf copies. BLOCKING saves (``snapshot=False``)
+        # skip the copy entirely and stream straight from the sources in
+        # ``write()``: the caller cannot run its next (donating) step
+        # until the save returns, so there is nothing to race — this
+        # removes both the memcpy and the arena's first-touch page-fault
+        # cost (~10 s/1.5 GB cold, memory notes in ``_Arena``) from the
+        # suspend path.
+        if not snapshot:
+            self.my_blocks = {
+                key: src for key, src, _shape, _dtype in specs
+            }
+            self._arena_buf = None
+            self._thread: Optional[threading.Thread] = None
+            self._write_err: Optional[BaseException] = None
+            self._done = False
+            return
         total = 0
         offs = []
         for _key, _src, shape, dtype in specs:
@@ -397,7 +413,10 @@ class _ShardedSave:
                     bytes.fromhex(self.token), np.uint8
                 ),
                 **{
-                    k: np.ascontiguousarray(v).reshape(-1).view(np.uint8)
+                    # np.asarray: no-snapshot blocks are still live jax
+                    # arrays (or numpy scalars) at write time
+                    k: np.ascontiguousarray(np.asarray(v))
+                    .reshape(-1).view(np.uint8)
                     for k, v in self.my_blocks.items()
                 },
             )
@@ -500,7 +519,7 @@ def save_sharded(dirpath: str | os.PathLike, payload: Any) -> None:
     (see ``_ShardedSave``). Synchronous; for the non-stalling trainer
     path use ``Checkpointer.save_*_sharded(block=False)`` + ``wait()``.
     """
-    s = _ShardedSave(dirpath, payload)
+    s = _ShardedSave(dirpath, payload, snapshot=False)
     s.write()
     s.finalize()
 
@@ -793,7 +812,9 @@ class Checkpointer:
     def _save_sharded(self, path: str, payload: Any, block: bool) -> None:
         self.wait()  # one in-flight save at a time; commit the previous
         if block:
-            s = _ShardedSave(path, payload, arena=self._arena)
+            # blocking: stream from the live buffers — no snapshot copy,
+            # no arena (the caller waits, so donation can't race)
+            s = _ShardedSave(path, payload, snapshot=False)
             s.write()
             s.finalize()
         else:

@@ -66,6 +66,24 @@ def main() -> None:
 
     d = tempfile.mkdtemp(prefix="ckpt_bench_")
     try:
+        # Concurrent raw-disk ceiling: the sync save is DISK-BOUND (the
+        # round-5 analysis — raw write+fsync of the same byte count
+        # measured 13.3 s = 116 MB/s on the shared disk the day the
+        # "regression" was chased; r3's 9.7 s was a faster-disk day).
+        # Measure it HERE, same minute, so ckpt_save_s is interpretable
+        # as efficiency-vs-disk instead of a disk-weather lottery.
+        probe_mb = 512 if not small else 8
+        probe = np.ones(probe_mb * 2**20, np.uint8)
+        pp = os.path.join(d, "disk_probe.bin")
+        t0 = time.perf_counter()
+        with open(pp, "wb") as f:
+            f.write(memoryview(probe))
+            f.flush()
+            os.fsync(f.fileno())
+        disk_mb_s = probe_mb / (time.perf_counter() - t0)
+        os.remove(pp)
+        del probe
+
         t0 = time.perf_counter()
         save_sharded(os.path.join(d, "latest.ckpt"), payload)
         save_s = time.perf_counter() - t0
@@ -90,10 +108,19 @@ def main() -> None:
         t0 = time.perf_counter()
         ck.save_best_sharded(payload, block=False)
         stall_first_s = time.perf_counter() - t0  # arena pre-faulted
-        ck.wait()
-        t0 = time.perf_counter()
-        ck.save_best_sharded(payload, block=False)
-        stall_s = time.perf_counter() - t0  # steady state: arena reused
+        # Steady state over FIVE saves, quoted as median + spread: the
+        # r4 driver captured a single second-save sample of 1.84 s that
+        # no instrumented rerun could reproduce (17 in-situ saves all
+        # 0.32-0.69 s; /proc counters showed no reclaim/THP/steal — a
+        # transient of the shared 1-core box). A single sample measures
+        # the box's weather; the median measures the checkpointer.
+        stalls = []
+        commit_s = 0.0
+        for _ in range(5):
+            ck.wait()  # commit previous (joins its write thread)
+            t0 = time.perf_counter()
+            ck.save_best_sharded(payload, block=False)
+            stalls.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         ck.wait()
         commit_s = time.perf_counter() - t0
@@ -103,11 +130,15 @@ def main() -> None:
     print(json.dumps({
         "ckpt_params_m": round(n_params / 1e6, 1),
         "ckpt_bytes_mb": round(total_bytes / 2**20, 1),
+        "ckpt_disk_mb_s": round(disk_mb_s, 1),
         "ckpt_save_s": round(save_s, 2),
+        "ckpt_save_disk_bound_s": round(total_bytes / 2**20 / disk_mb_s, 2),
         "ckpt_restore_s": round(restore_s, 2),
         "ckpt_arena_warm_bg_s": round(warm_s, 2),
         "ckpt_stall_first_s": round(stall_first_s, 2),
-        "ckpt_stall_s": round(stall_s, 2),
+        "ckpt_stall_s": round(float(np.median(stalls)), 2),
+        "ckpt_stall_min_s": round(min(stalls), 2),
+        "ckpt_stall_max_s": round(max(stalls), 2),
         "ckpt_commit_after_overlap_s": round(commit_s, 2),
         "ckpt_mb_per_s": round(total_bytes / 2**20 / max(save_s, 1e-9), 1),
     }))
