@@ -16,6 +16,8 @@ Subclass contract:
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 
@@ -65,19 +67,28 @@ class SuspendableTrainer:
         )
 
     def try_resume(self) -> bool:
-        """Restore from ``latest.ckpt`` if present (``restnet_ddp.py:127-132``).
+        """Restore the NEWEST restorable checkpoint: ``latest.ckpt``
+        (suspend save) or a ``step-*.ckpt`` interval save, whichever
+        carries the highest ``state/step`` (``restnet_ddp.py:127-132``
+        restores only latest — interval saves are a durability policy the
+        reference lacks, so a crash after them must not fall back to an
+        older suspend artifact).
 
         Sharded directories restore shard-wise (each process reads only the
         blocks its devices need); legacy single files restore via the old
         full-numpy path."""
-        if not self.ckpt.has_latest():
+        from pytorch_distributed_tpu.utils.checkpoint import load_sharded
+
+        self.ckpt.wait()
+        path = self.ckpt.newest_restorable()
+        if path is None:
             return False
-        if self.ckpt.latest_is_sharded():
+        if os.path.isdir(path):
             template = self._payload_live(0, 0)
             state_sh = self._state_shardings()
             shardings = jax.tree.map(lambda _: False, template)
             shardings["state"] = state_sh
-            restored = self.ckpt.load_latest_sharded(template, shardings)
+            restored = load_sharded(path, template, shardings)
             self.state = jax.device_put(restored["state"], state_sh)
         else:
             restored = self.ckpt.load_latest(self._payload(0, 0))
@@ -88,10 +99,27 @@ class SuspendableTrainer:
         self.start_step = int(restored["step"])
         self._restore_extra(restored)
         rank0_print(
-            f"resumed from {self.ckpt.latest_path}: "
+            f"resumed from {path}: "
             f"epoch {self.start_epoch} step {self.start_step}"
         )
         return True
+
+    def _maybe_save_step(self, epoch: int, step: int) -> None:
+        """Interval checkpoint hook: every ``save_every_n_steps`` train
+        steps, a non-blocking sharded save of the live state to
+        ``step-<global_step>.ckpt`` with keep-last-``keep_last_ckpts``
+        retention. The save's internal ``wait()`` commits the previous
+        in-flight save — every rank calls this at the same step, so the
+        collective ordering matches the suspend/best paths."""
+        every = getattr(self.config, "save_every_n_steps", 0)
+        if not every or (step + 1) % every:
+            return
+        gstep = int(np.asarray(jax.device_get(self.state.step)))
+        self.ckpt.save_step_sharded(
+            self._payload_live(epoch, step + 1), gstep,
+            keep_last=getattr(self.config, "keep_last_ckpts", 3),
+            block=False,
+        )
 
     # ---- the suspend agreement (ref restnet_ddp.py:36-47) ----
 

@@ -126,6 +126,10 @@ class LMTrainerConfig:
     # rejected. pp_microbatches follows BENCH_PP.md's measured default.
     pipeline_stages: int = 0
     pp_microbatches: int = 8
+    # Step-interval durability (0 = off; see TrainerConfig) — non-blocking
+    # sharded step-<global_step>.ckpt saves with keep-last-K retention.
+    save_every_n_steps: int = 0
+    keep_last_ckpts: int = 3
 
 
 class LMTrainer(SuspendableTrainer):
@@ -341,6 +345,7 @@ class LMTrainer(SuspendableTrainer):
                 )
                 self.metrics_log.log(kind="train", epoch=epoch, step=step,
                                      **last)
+            self._maybe_save_step(epoch, step)
             self._maybe_suspend(epoch, step)
         if steps_done:
             float(self.state.step)  # drain async dispatch before the clock

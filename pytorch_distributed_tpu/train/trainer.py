@@ -77,6 +77,13 @@ class TrainerConfig:
     # Global-norm gradient clipping (0 = off); sharding-correct under FSDP
     # (ops.optim.sharded_global_norm), applied after scaler unscale.
     grad_clip_norm: float = 0.0
+    # Step-interval durability (0 = off, the reference's policy: saves only
+    # on suspend and on val improvement). Every N steps a NON-BLOCKING
+    # sharded save lands in step-<global_step>.ckpt; retention keeps the
+    # newest keep_last_ckpts completed ones, and resume picks the newest
+    # restorable checkpoint (train/base.py, utils/checkpoint.py round 5).
+    save_every_n_steps: int = 0
+    keep_last_ckpts: int = 3
 
 
 class Trainer(SuspendableTrainer):
@@ -228,6 +235,7 @@ class Trainer(SuspendableTrainer):
                     kind="train", epoch=epoch, step=step, loss=last["loss"],
                     acc1=acc1,
                 )
+            self._maybe_save_step(epoch, step)
             self._maybe_suspend(epoch, step)
         if steps_done:
             # Drain the async dispatch queue with a value fetch before

@@ -712,6 +712,31 @@ def load_sharded(
     return jtu.tree_unflatten(treedef, restored)
 
 
+def peek_leaf(dirpath: str | os.PathLike, leaf_path: str):
+    """Read ONE leaf from a sharded checkpoint without a template —
+    cheap metadata probes (e.g. which of several checkpoints is newest
+    by its ``state/step``). Single-block leaves only (scalars and
+    replicated arrays — block 0 carries the whole value)."""
+    import json
+
+    dirpath = os.fspath(dirpath)
+    with open(os.path.join(dirpath, MANIFEST)) as f:
+        manifest = json.load(f)
+    meta = manifest["leaves"][leaf_path]
+    if len(meta["blocks"]) != 1:
+        raise ValueError(
+            f"peek_leaf reads single-block leaves; {leaf_path!r} has "
+            f"{len(meta['blocks'])} blocks"
+        )
+    b = meta["blocks"][0]
+    npz = np.load(os.path.join(dirpath, b["file"]), allow_pickle=False)
+    arr = npz[b["key"]].view(np.dtype(meta["dtype"]))
+    return arr.reshape(meta["shape"])
+
+
+STEP_CKPT_RE = re.compile(r"^step-(\d{8,})\.ckpt$")  # 8+: :08d overflows
+
+
 class Checkpointer:
     """latest/best artifact manager for a save directory.
 
@@ -732,6 +757,7 @@ class Checkpointer:
         self._pending: Optional[_ShardedSave] = None
         self._arena = _Arena()  # snapshot pages reused across saves
         self._warm_thread: Optional[threading.Thread] = None
+        self._step_keep: Optional[int] = None  # GC request, runs at wait()
 
     def _path(self, name: str) -> str:
         return os.path.join(self.save_dir, name)
@@ -832,6 +858,88 @@ class Checkpointer:
     def save_best_sharded(self, payload: Any, block: bool = True) -> None:
         self._save_sharded(self.best_path, payload, block)
 
+    # ---- step-interval checkpoints (save_every_n_steps, round 5) ----
+
+    def step_path(self, step: int) -> str:
+        return self._path(f"step-{int(step):08d}.ckpt")
+
+    def step_checkpoints(self) -> list:
+        """Completed (manifest-bearing) step checkpoints, oldest→newest
+        by the step number in the name."""
+        out = []
+        if not os.path.isdir(self.save_dir):
+            return out
+        for name in os.listdir(self.save_dir):
+            m = STEP_CKPT_RE.match(name)
+            p = os.path.join(self.save_dir, name)
+            if m and os.path.exists(os.path.join(p, MANIFEST)):
+                out.append((int(m.group(1)), p))
+        return sorted(out)  # numeric, not lexicographic (9+-digit steps)
+
+    def save_step_sharded(self, payload: Any, step: int,
+                          keep_last: int = 3, block: bool = False) -> None:
+        """Interval checkpoint ``step-<step>.ckpt`` on the non-stalling
+        sharded path (the reference saves only on suspend and on val
+        improvement, ``restnet_ddp.py:37-45,145-150`` — a multi-day run
+        between val epochs has zero durability; this is the missing
+        ``save_every_n_steps`` policy, VERDICT r4 next #6). Retention:
+        after this save COMMITS (at ``wait()``), completed step
+        checkpoints beyond the newest ``keep_last`` are removed —
+        incomplete ones (no manifest) are never counted as kept, and the
+        GC runs only after the new save's manifest landed, so it can
+        never delete the only complete checkpoint."""
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        self._save_sharded(self.step_path(step), payload, block)
+        self._step_keep = keep_last
+        if block:
+            self._gc_steps()
+
+    def _gc_steps(self) -> None:
+        """Remove completed step checkpoints beyond the newest
+        ``_step_keep``, and incomplete step dirs older than the newest
+        completed one (debris from crashed saves). Rank 0 only, AFTER the
+        commit barrier (shared-fs model, same as the manifest)."""
+        import shutil
+
+        keep, self._step_keep = self._step_keep, None
+        if keep is None or jax.process_index() != 0:
+            return
+        done = self.step_checkpoints()
+        for _step, path in done[:-keep] if len(done) > keep else []:
+            shutil.rmtree(path, ignore_errors=True)
+        if done:
+            newest_done = done[-1][0]
+            for name in os.listdir(self.save_dir):
+                m = STEP_CKPT_RE.match(name)
+                p = os.path.join(self.save_dir, name)
+                if (
+                    m and int(m.group(1)) < newest_done
+                    and not os.path.exists(os.path.join(p, MANIFEST))
+                ):
+                    shutil.rmtree(p, ignore_errors=True)
+
+    def newest_restorable(self) -> Optional[str]:
+        """The restorable checkpoint with the highest saved
+        ``state/step``: ``latest.ckpt`` (suspend save) or a step-interval
+        checkpoint — a crash after interval saves but before any suspend
+        must resume from the newest interval save, not an older latest."""
+        candidates = [p for _s, p in self.step_checkpoints()]
+        if self.has_latest():
+            candidates.append(self.latest_path)
+        best, best_step = None, -1
+        for p in candidates:
+            try:
+                if os.path.isdir(p):
+                    s = int(np.asarray(peek_leaf(p, "state/step")))
+                else:  # legacy single-file latest: prefer only if alone
+                    s = 0
+            except Exception:
+                continue
+            if s >= best_step:  # ties → later candidate (latest.ckpt)
+                best, best_step = p, s
+        return best
+
     def load_latest_sharded(self, template: Any, shardings: Any = None) -> Any:
         self.wait()
         return load_sharded(self.latest_path, template, shardings)
@@ -882,3 +990,4 @@ class Checkpointer:
         if self._pending is not None:
             pending, self._pending = self._pending, None
             pending.finalize()
+        self._gc_steps()  # retention only after the new manifest landed

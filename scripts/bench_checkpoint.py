@@ -115,7 +115,6 @@ def main() -> None:
         # transient of the shared 1-core box). A single sample measures
         # the box's weather; the median measures the checkpointer.
         stalls = []
-        commit_s = 0.0
         for _ in range(5):
             ck.wait()  # commit previous (joins its write thread)
             t0 = time.perf_counter()

@@ -25,11 +25,20 @@ def lm_cfg(**over):
     return tiny_config(**base)
 
 
+# LMTrainerConfig field names (trainer knobs); everything else in
+# make_lm_trainer's **cfg_over goes to the MODEL config
+_TRAINER_FIELDS = {f.name for f in __import__("dataclasses").fields(LMTrainerConfig)}
+
+
 def make_lm_trainer(save_dir, devices8, watcher=None, **cfg_over):
     mesh = make_mesh(devices8, data_parallel=2, seq_parallel=2,
                      model_parallel=2)
-    cfg = LMTrainerConfig(epochs=2, batch_size=2, lr=1e-2, save_dir=str(save_dir),
-                          num_workers=0, log_every=1, warmup_steps=0)
+    base = dict(epochs=2, batch_size=2, lr=1e-2, save_dir=str(save_dir),
+                num_workers=0, log_every=1, warmup_steps=0)
+    base.update(
+        {k: cfg_over.pop(k) for k in list(cfg_over) if k in _TRAINER_FIELDS}
+    )
+    cfg = LMTrainerConfig(**base)
     train = SyntheticTokens(size=16, seq_len=32, vocab_size=128)
     val = SyntheticTokens(size=8, seq_len=32, vocab_size=128, seed=9)
     return LMTrainer(lm_cfg(**cfg_over), train, val, cfg, mesh=mesh,

@@ -1,0 +1,118 @@
+"""Step-interval checkpointing: save_every_n_steps + keep-last-K retention
+(VERDICT r4 next #6). The reference saves only on suspend and on val
+improvement (restnet_ddp.py:37-45,145-150) — these tests cover the added
+durability policy: non-blocking step-<global_step>.ckpt saves, retention
+that can never delete the only complete checkpoint, and resume picking
+the newest restorable checkpoint (interval or suspend)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from pytorch_distributed_tpu.utils.checkpoint import (  # noqa: E402
+    MANIFEST,
+    Checkpointer,
+    peek_leaf,
+)
+from conftest import FireAtStep, assert_trees_equal  # noqa: E402
+
+
+def _payload(step):
+    return {
+        "state": {"step": jnp.asarray(step, jnp.int32),
+                  "w": jnp.full((4, 4), float(step))},
+        "epoch": 0, "step": step,
+    }
+
+
+def _step_dirs(d):
+    return sorted(
+        n for n in os.listdir(d)
+        if n.startswith("step-") and n.endswith(".ckpt")
+    )
+
+
+def test_retention_keeps_last_k(tmp_path):
+    d = str(tmp_path)
+    ck = Checkpointer(d)
+    for s in range(1, 6):
+        ck.save_step_sharded(_payload(s), s, keep_last=2, block=False)
+    ck.wait()
+    assert _step_dirs(d) == ["step-00000004.ckpt", "step-00000005.ckpt"]
+    # the kept ones are complete and restorable
+    for n in _step_dirs(d):
+        assert os.path.exists(os.path.join(d, n, MANIFEST))
+    assert int(np.asarray(
+        peek_leaf(os.path.join(d, "step-00000005.ckpt"), "state/step")
+    )) == 5
+
+
+def test_retention_never_deletes_only_complete(tmp_path):
+    d = str(tmp_path)
+    ck = Checkpointer(d)
+    ck.save_step_sharded(_payload(1), 1, keep_last=1, block=True)
+    # a NEWER but incomplete dir (crash mid-save: no manifest) must not
+    # count as kept and must not displace the only complete checkpoint
+    os.makedirs(os.path.join(d, "step-00000009.ckpt"))
+    ck.save_step_sharded(_payload(2), 2, keep_last=1, block=True)
+    dirs = _step_dirs(d)
+    assert "step-00000002.ckpt" in dirs
+    assert "step-00000001.ckpt" not in dirs  # rotated out, keep_last=1
+    assert "step-00000009.ckpt" in dirs  # newer-incomplete left alone
+    # an incomplete dir OLDER than the newest complete one is debris
+    os.makedirs(os.path.join(d, "step-00000000.ckpt"))
+    ck.save_step_sharded(_payload(3), 3, keep_last=1, block=True)
+    assert "step-00000000.ckpt" not in _step_dirs(d)
+
+
+def test_newest_restorable_prefers_highest_step(tmp_path):
+    d = str(tmp_path)
+    ck = Checkpointer(d)
+    ck.save_latest_sharded(_payload(5))  # suspend save at step 5
+    ck.save_step_sharded(_payload(8), 8, keep_last=2, block=True)
+    assert ck.newest_restorable().endswith("step-00000008.ckpt")
+    # a newer suspend save wins back
+    ck.save_latest_sharded(_payload(11))
+    assert ck.newest_restorable().endswith("latest.ckpt")
+
+
+def test_interval_resume_bit_exact(tmp_path, devices8):
+    """A crash after interval saves (no suspend artifact at all) must
+    resume from the newest step checkpoint and replay to the exact end
+    state of an uninterrupted run."""
+    from test_lm_trainer import make_lm_trainer
+
+    t_ref = make_lm_trainer(tmp_path / "ref", devices8)
+    t_ref.fit()
+
+    t_int = make_lm_trainer(
+        tmp_path / "int", devices8, save_every_n_steps=3,
+        keep_last_ckpts=2,
+    )
+    t_int.fit()
+    # interval saves don't perturb training math
+    assert_trees_equal(t_ref.state.params, t_int.state.params)
+    d = str(tmp_path / "int")
+    steps = _step_dirs(d)
+    assert 1 <= len(steps) <= 2  # retention bound
+    # simulate a crash that left ONLY interval checkpoints behind
+    import shutil
+
+    for n in ("best.ckpt", "latest.ckpt"):
+        shutil.rmtree(os.path.join(d, n), ignore_errors=True)
+    t_res = make_lm_trainer(
+        tmp_path / "int", devices8, save_every_n_steps=3,
+        keep_last_ckpts=2,
+    )
+    assert t_res.try_resume()
+    assert (t_res.start_epoch, t_res.start_step) != (0, 0)
+    t_res.fit()  # try_resume inside fit() is idempotent on the same dir
+    assert_trees_equal(t_ref.state.params, t_res.state.params)
+    assert int(jax.device_get(t_ref.state.step)) == int(
+        jax.device_get(t_res.state.step)
+    )
