@@ -206,6 +206,13 @@ def _generate_tp_compiled(mesh, config, max_new_tokens, temperature, top_k):
                   for part in spec)))
         for pat, spec in TRANSFORMER_TP_RULES
     ]
+    if getattr(config, "vocab_parallel", False) and config.tp_size > 1:
+        # vocab-parallel head/embedding shards (train/lm._vocab_rules
+        # builds specs from the config's own axis name — no remap);
+        # the model all_gathers the logits, so sampling stays replicated
+        from pytorch_distributed_tpu.train.lm import _vocab_rules
+
+        rules += [(pat, P(*spec)) for pat, spec in _vocab_rules(config)]
 
     def local(params, prompt, rng):
         return _generate_core(config, params, prompt, rng, max_new_tokens,

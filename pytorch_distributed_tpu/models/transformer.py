@@ -78,6 +78,16 @@ class TransformerConfig:
     # feature widths flax should expect at apply time. None/1 = no TP.
     model_axis: Optional[str] = None
     tp_size: int = 1
+    # Megatron vocab parallelism (round 5): shard the wte embedding's and
+    # lm_head's VOCAB dim over the model axis. The embedding does a
+    # masked local lookup psum'd across shards; the loss tail feeds the
+    # LOCAL head shard to the fused CE's cross-shard logsumexp
+    # (ops/fused_ce.py vocab_axis); the logits path (generate/eval
+    # fallback) all_gathers the vocab dim. Cuts the lm_head+wte param,
+    # grad, and optimizer memory — and the fused-CE block compute — by
+    # tp. Effective only when model_axis/tp_size are set, like every
+    # other TP switch; parameters keep GLOBAL shapes in the state.
+    vocab_parallel: bool = False
     # Mixture-of-Experts (models/moe.py): n_experts > 0 replaces the dense
     # MLP with a Switch-style MoE in every ``moe_every``-th block. Expert
     # parallelism rides the data axis: set expert_axis/ep_size to the mesh's
@@ -153,6 +163,11 @@ class TransformerConfig:
                     f"tp_size {self.tp_size} (each TP rank needs whole KV "
                     "heads)"
                 )
+        if self.vocab_parallel and self.vocab_size % self.tp_size:
+            raise ValueError(
+                f"vocab_size {self.vocab_size} not divisible by tp_size "
+                f"{self.tp_size} (vocab_parallel shards the vocab dim)"
+            )
         if self.tp_size > 1 and self.model_axis is None:
             raise ValueError(
                 f"tp_size {self.tp_size} > 1 requires model_axis: without "
@@ -497,7 +512,32 @@ class TransformerLM(nn.Module):
         # from (seed, step, shard coords) so resumed runs are bit-identical).
         inference = decode or prefill
         deterministic = not (train and cfg.dropout > 0.0) or inference
-        x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype, name="wte")(tokens)
+        vp = cfg.vocab_parallel and cfg.model_axis is not None
+        if vp:
+            # Vocab-parallel embedding: each shard owns vocab rows
+            # [r*V/tp, (r+1)*V/tp); out-of-range tokens look up a clipped
+            # row, are zero-masked, and tp_reduce (psum forward, IDENTITY
+            # backward — the Megatron g; a plain psum would transpose to
+            # another psum and scale wte grads by tp) assembles the one
+            # real row per token. The mask kills foreign rows'
+            # cotangents, so each shard's wte grad lands only on the
+            # rows it owns.
+            from pytorch_distributed_tpu.parallel.tensor import tp_reduce
+
+            v_loc = cfg.vocab_size // cfg.tp_size
+            off = jax.lax.axis_index(cfg.model_axis) * v_loc
+            loc = tokens - off
+            ok = (loc >= 0) & (loc < v_loc)
+            emb = nn.Embed(v_loc, cfg.embed_dim, dtype=cfg.dtype,
+                           name="wte")(jnp.clip(loc, 0, v_loc - 1))
+            x = tp_reduce(
+                jnp.where(ok[..., None], emb, jnp.zeros((), emb.dtype)),
+                cfg.model_axis,
+            )
+        else:
+            x = nn.Embed(
+                cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype, name="wte"
+            )(tokens)
         # ``positions`` ([L_local] i32) overrides the contiguous
         # offset+arange convention — required for the zigzag ring layout,
         # whose shards hold non-contiguous chunk pairs (train/lm.py
@@ -547,7 +587,8 @@ class TransformerLM(nn.Module):
             )(x, position_offset, pos)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         head = nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
+            cfg.vocab_size // cfg.tp_size if vp else cfg.vocab_size,
+            use_bias=False, dtype=cfg.dtype, name="lm_head",
         )
         if return_hidden:
             # Fused-CE path (ops/fused_ce.py): the caller streams the
@@ -560,7 +601,24 @@ class TransformerLM(nn.Module):
             # leaves the existing lm_head params unused, which flax
             # tolerates — checkpoint layout identical either way.
             return x
-        return head(x).astype(jnp.float32)
+        if vp:
+            # column-parallel head: replicated input, vocab-sharded
+            # output — the f-operator (identity fwd, psum bwd) collects
+            # each shard's dx contribution, exactly like qkv/mlp_up
+            from pytorch_distributed_tpu.parallel.tensor import tp_copy
+
+            x = tp_copy(x, cfg.model_axis)
+        logits = head(x).astype(jnp.float32)
+        if vp:
+            # full logits for sampling/eval callers: concatenate the
+            # vocab shards in axis order (matches the shard offsets).
+            # tp_all_gather, not lax.all_gather: downstream losses are
+            # replicated over the model axis, and the raw gather's
+            # psum_scatter transpose would scale grads by tp.
+            from pytorch_distributed_tpu.parallel.tensor import tp_all_gather
+
+            logits = tp_all_gather(logits, cfg.model_axis, dim=-1)
+        return logits
 
 
 def tiny_config(**overrides) -> TransformerConfig:

@@ -76,6 +76,36 @@ def tp_reduce(x, axis_name: str = MODEL_AXIS):
     return _tp_reduce(x, axis_name)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _tp_all_gather(x, axis_name: str, dim: int):
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _tp_all_gather_fwd(x, axis_name, dim):
+    return _tp_all_gather(x, axis_name, dim), x.shape[dim]
+
+
+def _tp_all_gather_bwd(axis_name, dim, local, g):
+    r = jax.lax.axis_index(axis_name)
+    return (jax.lax.dynamic_slice_in_dim(g, r * local, local, axis=dim),)
+
+
+_tp_all_gather.defvjp(_tp_all_gather_fwd, _tp_all_gather_bwd)
+
+
+def tp_all_gather(x, axis_name: str = MODEL_AXIS, dim: int = -1):
+    """All-gather forward, slice backward — for REPLICATED downstream
+    consumers (e.g. the vocab-parallel logits feeding a loss every model
+    shard computes identically). The raw ``lax.all_gather`` transposes to
+    psum_scatter, which SUMS the tp identical replicated cotangents and
+    hands each shard tp× its true gradient; the slice backward takes
+    exactly this shard's piece of the (replicated) cotangent instead —
+    the same f/g bookkeeping as ``tp_copy``/``tp_reduce``."""
+    if dim < 0:
+        dim += x.ndim
+    return _tp_all_gather(x, axis_name, dim)
+
+
 # ---- partition rules (the standard path-regex → PartitionSpec mapping) ----
 
 

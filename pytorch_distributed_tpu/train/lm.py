@@ -92,7 +92,9 @@ def create_lm_state(
 
 # Megatron-style placement for TransformerLM parameters (paths from the flax
 # module tree). Column-parallel layers shard their output dim, row-parallel
-# their input dim; embeddings, layernorms, and lm_head stay replicated.
+# their input dim; layernorms and wpe stay replicated. wte and lm_head stay
+# replicated by DEFAULT; ``config.vocab_parallel`` shards their vocab dim
+# (``_vocab_rules`` — conditional, like the MoE placements).
 TRANSFORMER_TP_RULES = (
     (r"attn/qkv/kernel", P(None, None, MODEL_AXIS, None)),  # [E,3,H,D] → H
     (r"attn/qkv/bias", P(None, MODEL_AXIS, None)),  # [3,H,D] → H
@@ -131,6 +133,29 @@ def _moe_rules(config):
     )
 
 
+def _vocab_rules(config):
+    """Vocab-parallel placements (config.vocab_parallel): wte shards its
+    vocab rows, lm_head its vocab columns, both over the model axis."""
+    tp = (
+        config.model_axis
+        if config.model_axis is not None and config.tp_size > 1
+        else None
+    )
+    return (
+        (r"wte/embedding", P(tp, None)),  # [V, E] → V
+        (r"lm_head/kernel", P(None, tp)),  # [E, V] → V
+    )
+
+
+def _uses_vocab_parallel(config) -> bool:
+    return (
+        config is not None
+        and getattr(config, "vocab_parallel", False)
+        and config.model_axis is not None
+        and config.tp_size > 1
+    )
+
+
 def _has_moe_params(params) -> bool:
     from pytorch_distributed_tpu.parallel.tensor import path_str
 
@@ -164,6 +189,8 @@ def lm_state_specs(state: TrainState, rules=None, config=None) -> TrainState:
                     "expert_axis/tp_size) is known"
                 )
             rules = rules + _moe_rules(config)
+        if _uses_vocab_parallel(config):
+            rules = rules + _vocab_rules(config)
     param_specs = match_partition_rules(rules, state.params)
     return state.replace(
         step=P(),
@@ -226,6 +253,8 @@ def _lm_placement_rules(tree, config):
                 "indistinguishable from FSDP storage shards"
             )
         rules = rules + _moe_rules(config)
+    if _uses_vocab_parallel(config):
+        rules = rules + _vocab_rules(config)
     return rules
 
 
@@ -352,7 +381,8 @@ def check_seq_parallel_attention(mesh: Mesh, config, seq_axis: str = SEQ_AXIS):
 def _lm_loss_sum(apply_out, params, batch, config, use_fused, block_n):
     """Weighted CE sum for one step's model output — the ONE loss tail
     both the train and eval steps use. ``apply_out`` is post-ln_f hidden
-    states (fused path) or full logits (``use_fused=False``)."""
+    states (fused path) or full logits (``use_fused=False``; under
+    vocab_parallel the model already all_gathered them)."""
     if use_fused:
         return fused_linear_cross_entropy(
             apply_out,
@@ -361,6 +391,12 @@ def _lm_loss_sum(apply_out, params, batch, config, use_fused, block_n):
             batch["weights"],
             block_n=block_n,
             compute_dtype=config.dtype,
+            # vocab-parallel head: the kernel leaf here is the LOCAL
+            # [E, V/tp] shard; the fused CE combines the streamed softmax
+            # stats across shards and psums dx the row-parallel way
+            vocab_axis=(
+                config.model_axis if _uses_vocab_parallel(config) else None
+            ),
         )
     per_tok = cross_entropy_loss(
         apply_out.reshape(-1, apply_out.shape[-1]),

@@ -147,6 +147,14 @@ def create_pp_lm_state(
         raise ValueError(
             f"num_layers {config.num_layers} not divisible by n_stages {n_stages}"
         )
+    if config.vocab_parallel:
+        raise ValueError(
+            "vocab_parallel does not compose with the PP trainer: PPEmbed/"
+            "PPHead params are stage-replicated and their grads psum over "
+            "the stage axis (train/pp.py grad combine) — a vocab-sharded "
+            "embedding there would need its own placement + combine rules. "
+            "Use the (data, seq, model) LM trainer for vocab parallelism."
+        )
     lps = config.num_layers // n_stages
     if config.n_experts and lps % config.moe_every:
         raise ValueError(

@@ -1,0 +1,186 @@
+"""Megatron vocab parallelism (VERDICT r4 next #4): wte + lm_head shard
+their vocab dim over the model axis. Parity vs replicated at tp∈{2,4},
+through the fused-CE loss tail (cross-shard logsumexp) AND the
+materialized-logits path (masked-lookup psum embedding + all_gathered
+head), decode parity via generate_tp, and checkpoint interchangeability
+across tp degrees (global param shapes; placement does the sharding)."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from pytorch_distributed_tpu.models.transformer import tiny_config  # noqa: E402
+from pytorch_distributed_tpu.parallel import make_mesh  # noqa: E402
+from pytorch_distributed_tpu.train.lm import (  # noqa: E402
+    create_lm_state,
+    empty_lm_metrics,
+    make_lm_eval_step,
+    make_lm_train_step,
+    shard_lm_state,
+    shift_labels,
+)
+from conftest import assert_trees_equal  # noqa: E402
+
+
+def _cfgs(tp):
+    rep = tiny_config(vocab_size=96, num_layers=2, num_heads=4)
+    vp = dataclasses.replace(
+        rep, model_axis="model", tp_size=tp, vocab_parallel=True
+    )
+    return rep, vp
+
+
+def _batch(cfg, b=4, l=32, seed=0):
+    r = np.random.RandomState(seed)
+    tokens = r.randint(0, cfg.vocab_size, (b, l)).astype(np.int32)
+    labels, w = shift_labels(tokens)
+    return {"tokens": jnp.asarray(tokens), "labels": jnp.asarray(labels),
+            "weights": jnp.asarray(w)}
+
+
+def _run_steps(cfg, mesh, batch, n=3, fused=True):
+    state = create_lm_state(cfg, optax.sgd(0.1), jax.random.key(0),
+                            init_len=32)
+    state, specs = shard_lm_state(mesh, state, cfg)
+    step = make_lm_train_step(mesh, state_specs=specs, config=cfg,
+                              fused_ce=fused, fused_ce_block_n=16)
+    losses = []
+    for _ in range(n):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    return losses, jax.device_get(state.params), state, specs
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+@pytest.mark.parametrize("fused", [True, False])
+def test_train_parity_vs_replicated(tp, fused):
+    rep, vp = _cfgs(tp)
+    batch = _batch(rep)
+    mesh_rep = make_mesh(jax.devices()[:2], data_parallel=2, seq_parallel=1,
+                         model_parallel=1)
+    mesh_vp = make_mesh(jax.devices()[:2 * tp], data_parallel=2,
+                        seq_parallel=1, model_parallel=tp)
+    l_rep, p_rep, *_ = _run_steps(rep, mesh_rep, batch, fused=fused)
+    l_vp, p_vp, state_vp, _ = _run_steps(vp, mesh_vp, batch, fused=fused)
+    np.testing.assert_allclose(l_vp, l_rep, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        p_vp, p_rep,
+    )
+    # the vocab dims really are sharded on the mesh
+    wte = state_vp.params["wte"]["embedding"]
+    assert next(iter(wte.addressable_shards)).data.shape[0] == \
+        wte.shape[0] // tp
+    head = state_vp.params["lm_head"]["kernel"]
+    assert next(iter(head.addressable_shards)).data.shape[1] == \
+        head.shape[1] // tp
+
+
+def test_generate_tp_vocab_parallel_parity():
+    from pytorch_distributed_tpu.models.generate import generate, generate_tp
+
+    rep, vp = _cfgs(2)
+    mesh = make_mesh(jax.devices()[:2], data_parallel=1, seq_parallel=1,
+                     model_parallel=2)
+    state = create_lm_state(rep, optax.sgd(0.1), jax.random.key(1),
+                            init_len=32)
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(1, 96, (2, 8)), jnp.int32
+    )
+    out_rep = generate(rep, state.params, prompt, jax.random.key(5),
+                       max_new_tokens=8)
+    out_vp = generate_tp(mesh, vp, state.params, prompt, jax.random.key(5),
+                         max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out_vp), np.asarray(out_rep))
+
+
+def test_checkpoint_interchangeable_across_degrees(tmp_path):
+    """Train 2 steps vocab-parallel at tp=2, save sharded, restore into
+    the REPLICATED config — eval loss must match the vp run's eval
+    (global param shapes make the checkpoint degree-free)."""
+    from pytorch_distributed_tpu.parallel.mesh import specs_to_shardings
+    from pytorch_distributed_tpu.utils.checkpoint import (
+        load_sharded,
+        save_sharded,
+    )
+
+    rep, vp = _cfgs(2)
+    batch = _batch(rep)
+    mesh_vp = make_mesh(jax.devices()[:4], data_parallel=2, seq_parallel=1,
+                        model_parallel=2)
+    _, _, state_vp, specs_vp = _run_steps(vp, mesh_vp, batch, n=2)
+    ev_vp = make_lm_eval_step(mesh_vp, state_specs=specs_vp, config=vp)
+    acc_vp = jax.device_get(ev_vp(state_vp, batch, empty_lm_metrics()))
+
+    d = str(tmp_path / "vp.ckpt")
+    save_sharded(d, {"state": state_vp})
+
+    mesh_rep = make_mesh(jax.devices()[:2], data_parallel=2, seq_parallel=1,
+                         model_parallel=1)
+    state_rep = create_lm_state(rep, optax.sgd(0.1), jax.random.key(0),
+                                init_len=32)
+    state_rep, specs_rep = shard_lm_state(mesh_rep, state_rep, rep)
+    restored = load_sharded(
+        d, {"state": state_rep},
+        {"state": specs_to_shardings(mesh_rep, specs_rep)},
+    )
+    state_rep = restored["state"]
+    ev_rep = make_lm_eval_step(mesh_rep, state_specs=specs_rep, config=rep)
+    acc_rep = jax.device_get(ev_rep(state_rep, batch, empty_lm_metrics()))
+    np.testing.assert_allclose(
+        float(acc_rep["loss_sum"]), float(acc_vp["loss_sum"]), rtol=1e-5
+    )
+
+
+def test_vocab_parallel_rejected_under_pp():
+    from pytorch_distributed_tpu.train.pp import create_pp_lm_state
+
+    _, vp = _cfgs(2)
+    vp = dataclasses.replace(vp, num_layers=4)
+    with pytest.raises(ValueError, match="vocab_parallel"):
+        create_pp_lm_state(vp, 2, optax.sgd(0.1), jax.random.key(0))
+
+
+def test_vocab_size_divisibility_checked():
+    with pytest.raises(ValueError, match="not divisible"):
+        tiny_config(vocab_size=97, model_axis="model", tp_size=2,
+                    vocab_parallel=True)
+
+
+def test_vocab_parallel_composes_with_fsdp():
+    """The vp rules CLAIM wte/lm_head, so the FSDP overlay must leave
+    them TP-sharded (not ZeRO-sharded) and the step must still match the
+    plain replicated run."""
+    from pytorch_distributed_tpu.ops.optim import spec_axes
+
+    rep, vp = _cfgs(2)
+    batch = _batch(rep)
+    mesh_rep = make_mesh(jax.devices()[:2], data_parallel=2, seq_parallel=1,
+                         model_parallel=1)
+    mesh_vp = make_mesh(jax.devices()[:4], data_parallel=2, seq_parallel=1,
+                        model_parallel=2)
+    l_rep, p_rep, *_ = _run_steps(rep, mesh_rep, batch)
+
+    state = create_lm_state(vp, optax.sgd(0.1), jax.random.key(0),
+                            init_len=32)
+    state, specs = shard_lm_state(mesh_vp, state, vp, fsdp=True)
+    assert set(spec_axes(specs.params["lm_head"]["kernel"])) == {"model"}
+    assert set(spec_axes(specs.params["wte"]["embedding"])) == {"model"}
+    step = make_lm_train_step(mesh_vp, state_specs=specs, config=vp,
+                              fsdp=True, fused_ce_block_n=16)
+    losses = []
+    for _ in range(3):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, l_rep, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        jax.device_get(state.params), p_rep,
+    )
