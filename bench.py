@@ -299,6 +299,9 @@ def main() -> None:
             r = bench_serving.measure(slots=32, max_new=64)
             r.pop("device", None)
             record.update(r)
+            record.update(bench_serving.measure_admission_stall(
+                slots=32, tick_ms=r["serving_decode_ms_per_token"]
+            ))
         except Exception as e:
             record["serving_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_FP32", "1") == "1":

@@ -1,0 +1,109 @@
+"""Tensor-parallel ragged serving (VERDICT r4 next #5): the continuous
+batcher and generate_ragged run under shard_map over the model axis —
+KV cache head-sharded, Megatron collectives inside each program —
+pinned token-exact against the replicated serving path."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from pytorch_distributed_tpu.models.generate import (  # noqa: E402
+    ContinuousBatcher,
+    generate_ragged,
+    generate_ragged_tp,
+)
+from pytorch_distributed_tpu.models.transformer import (  # noqa: E402
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.parallel import make_mesh  # noqa: E402
+
+
+def setup(tp=2, **over):
+    rep = tiny_config(attention="dense", max_seq_len=96, num_heads=4,
+                      **over)
+    vp = dataclasses.replace(rep, model_axis="model", tp_size=tp)
+    params = TransformerLM(rep).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = make_mesh(jax.devices()[:tp], data_parallel=1, seq_parallel=1,
+                     model_parallel=tp)
+    return rep, vp, params, mesh
+
+
+def _ragged_inputs(cfg, lengths, pad_to=32):
+    rng = np.random.default_rng(0)
+    prompts = np.zeros((len(lengths), pad_to), np.int32)
+    for i, l in enumerate(lengths):
+        prompts[i, :l] = rng.integers(1, cfg.vocab_size, (l,))
+    return jnp.asarray(prompts), jnp.asarray(lengths, jnp.int32)
+
+
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_generate_ragged_tp_parity(kv_heads):
+    rep, tpcfg, params, mesh = setup(num_kv_heads=kv_heads)
+    prompts, lengths = _ragged_inputs(rep, [5, 17, 32, 9])
+    out_rep = generate_ragged(rep, params, prompts, lengths,
+                              jax.random.key(1), max_new_tokens=8)
+    out_tp = generate_ragged_tp(mesh, tpcfg, params, prompts, lengths,
+                                jax.random.key(1), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out_tp), np.asarray(out_rep))
+
+
+def test_generate_ragged_tp_vocab_parallel_parity():
+    rep, tpcfg, params, mesh = setup()
+    vp = dataclasses.replace(tpcfg, vocab_parallel=True)
+    prompts, lengths = _ragged_inputs(rep, [5, 17])
+    out_rep = generate_ragged(rep, params, prompts, lengths,
+                              jax.random.key(1), max_new_tokens=8)
+    out_vp = generate_ragged_tp(mesh, vp, params, prompts, lengths,
+                                jax.random.key(1), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out_vp), np.asarray(out_rep))
+
+
+def _drive(batcher, prompts_list, max_new, eos=None):
+    """Deterministic submit/step schedule; returns {req: [tokens]}."""
+    produced = {}
+    pending = list(enumerate(prompts_list))
+    slot_req = {}
+    while pending or any(batcher.remaining > 0):
+        while pending and batcher.free_slots():
+            req, p = pending.pop(0)
+            slot = batcher.submit(p, max_new)
+            slot_req[slot] = req
+            produced[req] = []
+        for slot, tok in batcher.step():
+            produced[slot_req[slot]].append(tok)
+    return produced
+
+
+def test_batcher_tp_parity_vs_replicated():
+    """Same submit/step schedule, same seeds: the TP batcher must emit
+    token-identical streams — including slot retirement and reuse."""
+    rep, tpcfg, params, mesh = setup()
+    rng = np.random.default_rng(2)
+    prompts = [
+        rng.integers(1, rep.vocab_size, (l,)).astype(np.int32)
+        for l in (5, 11, 7, 3)
+    ]
+    b_rep = ContinuousBatcher(rep, params, n_slots=2, prefill_bucket=8)
+    b_tp = ContinuousBatcher(tpcfg, params, n_slots=2, prefill_bucket=8,
+                             mesh=mesh)
+    out_rep = _drive(b_rep, prompts, 6)
+    out_tp = _drive(b_tp, prompts, 6)
+    assert out_rep == out_tp
+    # the TP cache really is head-sharded at rest
+    leaf = jax.tree.leaves(b_tp.cache)[0]
+    assert next(iter(leaf.addressable_shards)).data.shape[2] == \
+        leaf.shape[2] // 2
+
+
+def test_batcher_tp_requires_mesh():
+    rep, tpcfg, params, _mesh = setup()
+    with pytest.raises(ValueError, match="mesh"):
+        ContinuousBatcher(tpcfg, params, n_slots=2)

@@ -26,9 +26,6 @@ from pytorch_distributed_tpu.train.lm import (  # noqa: E402
     shard_lm_state,
     shift_labels,
 )
-from conftest import assert_trees_equal  # noqa: E402
-
-
 def _cfgs(tp):
     rep = tiny_config(vocab_size=96, num_layers=2, num_heads=4)
     vp = dataclasses.replace(
