@@ -282,6 +282,143 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+):
+    """Single-pass backward (round 5, the r4-named kernel-family exit):
+    grid (BH, KV, Q) with Q innermost. Computes S and dP ONCE per
+    (q, kv) block and feeds all three products — where the split
+    kernels spend 7 big matmuls (dQ pass: S, dP, dQ; dKV pass: S, dV,
+    dP, dK) and read Q/K/V/dO twice, this spends the mathematical
+    minimum 5 and reads once. dK/dV accumulate in VMEM across the
+    inner Q sweep; dQ's cross-KV accumulation cannot live in VMEM in
+    this grid order (non-consecutive revisits), so each (kv, q) step
+    emits a PARTIAL dQ block to HBM (input dtype — see
+    ``_flash_bwd_fused``) and one XLA reduction over the KV axis
+    finishes it outside (traffic ≈ n_k · |dQ|, measured against the
+    saved matmuls in BENCH_ATTENTION.md r5)."""
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    k_start = pl.program_id(1) * block_k
+    q_start = qi * block_q
+
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < kv_len
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # see dq kernel note
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * jnp.asarray(scale, jnp.float32)
+        dsc = ds.astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            dsc, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            dsc, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dqp_ref.dtype)
+
+    if causal:
+        # fully-above-diagonal (q, kv) blocks contribute nothing — but
+        # their dq partial block must still be ZEROED (the out buffer is
+        # otherwise uninitialized memory)
+        @pl.when(q_start + block_q - 1 < k_start)
+        def _skip():
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+        pl.when(q_start + block_q - 1 >= k_start)(_block)
+    else:
+        _block()
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused(q3, k3, v3, o3, lse3, do3, scale, causal, blocks,
+                     kv_len, interpret, delta3=None, partials_f32=False):
+    """One fused kernel + one XLA reduction. ``blocks`` = (block_q,
+    block_k) shared by the whole pass."""
+    bh, lq, d = q3.shape
+    lk = k3.shape[1]
+    if delta3 is None:
+        delta3 = compute_delta(do3, o3)
+    bq, bk = blocks
+    n_k = lk // bk
+    # dQ partials at the INPUT dtype (default): halves the partial HBM
+    # traffic. The same-process A/B (BENCH_ATTENTION.md r5) measured
+    # input-dtype partials faster at BOTH 4096 and 8192 (108.6/113.9 vs
+    # 104.6/107.4 TFLOP/s) — an earlier cross-run reading that suggested
+    # fp32 wins at 4096 was tunnel weather. The cross-partial sum always
+    # accumulates in fp32; ``partials_f32`` remains as a sweep/precision
+    # knob (each bf16 partial rounds before the sum).
+    p_dtype = jnp.float32 if partials_f32 else q3.dtype
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    row_spec = pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    dqp3, dk3, dv3 = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, kv_len=kv_len),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n_k, lq, d), p_dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), v3.dtype),
+        ],
+        grid=(bh, n_k, lq // bq),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, j, i: (b, j, i, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q3, k3, v3, do3, lse3, delta3)
+    dq3 = jnp.sum(dqp3.astype(jnp.float32), axis=1).astype(q3.dtype)
+    return dq3, dk3, dv3
+
+
 def compute_delta(do3, o3):
     """Δ = rowsum(dO ⊙ O) broadcast to the [BH, Lq, 128] row layout LSE
     uses — shard-invariant, so ring callers compute it ONCE outside their
@@ -352,12 +489,13 @@ def _flash_bwd(q3, k3, v3, o3, lse3, do3, scale, causal, dq_blocks,
     return dq3, dk3, dv3
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, scale, causal, block_q, block_k, kv_len, interpret,
-           dq_blocks=None, dkv_blocks=None):
+           dq_blocks=None, dkv_blocks=None, bwd_impl="split"):
     out, _ = _flash_vjp_fwd(
         q, k, v, scale, causal, block_q, block_k, kv_len, interpret,
-        dq_blocks, dkv_blocks,
+        dq_blocks, dkv_blocks, bwd_impl,
     )
     return out
 
@@ -373,7 +511,8 @@ def _from3(x3, b, h):
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, kv_len,
-                   interpret, dq_blocks=None, dkv_blocks=None):
+                   interpret, dq_blocks=None, dkv_blocks=None,
+                   bwd_impl="split"):
     b, lq, h, d = q.shape
     o3, lse3 = _flash_fwd(
         _to3(q), _to3(k), _to3(v), scale, causal, block_q, block_k, kv_len,
@@ -383,7 +522,7 @@ def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, kv_len,
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, kv_len, interpret,
-                   dq_blocks, dkv_blocks, res, g):
+                   dq_blocks, dkv_blocks, bwd_impl, res, g):
     q, k, v, o3, lse3 = res
     b, lq, h, d = q.shape
     # The backward tiles independently of the forward; flash_attention
@@ -391,10 +530,16 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, kv_len, interpret,
     # fall back to the forward tiling).
     dq_blocks = dq_blocks or (block_q, block_k)
     dkv_blocks = dkv_blocks or (block_q, block_k)
-    dq3, dk3, dv3 = _flash_bwd(
-        _to3(q), _to3(k), _to3(v), o3, lse3, _to3(g.astype(q.dtype)),
-        scale, causal, dq_blocks, dkv_blocks, kv_len, interpret,
-    )
+    if bwd_impl == "fused":
+        dq3, dk3, dv3 = _flash_bwd_fused(
+            _to3(q), _to3(k), _to3(v), o3, lse3, _to3(g.astype(q.dtype)),
+            scale, causal, dq_blocks, kv_len, interpret,
+        )
+    else:
+        dq3, dk3, dv3 = _flash_bwd(
+            _to3(q), _to3(k), _to3(v), o3, lse3, _to3(g.astype(q.dtype)),
+            scale, causal, dq_blocks, dkv_blocks, kv_len, interpret,
+        )
     return _from3(dq3, b, h), _from3(dk3, b, h), _from3(dv3, b, h)
 
 
@@ -413,6 +558,7 @@ def flash_attention(
     bwd_block_q: Optional[int] = None,
     bwd_block_k: Optional[int] = None,
     interpret: bool | None = None,
+    bwd_impl: str = "fused",
 ) -> jax.Array:
     """FlashAttention: ``softmax(QKᵀ·scale)V`` tiled through VMEM.
 
@@ -420,15 +566,19 @@ def flash_attention(
       q, k, v: ``[B, L, H, D]``; any lengths — inputs are zero-padded to
         block multiples and padded key positions are masked in-kernel
         (round 1 required exact multiples).
-      bwd_block_q/bwd_block_k: ONE tiling for both backward kernels
-        (sweep/debug override). When left None, the backward auto-tiles
-        by length from the r4 composed sweep: (1024, 1024) for both
-        kernels at padded L >= 4096 (89.8 / 99.1 TFLOP/s fwdbwd at
-        4096/8192 vs 89.1 / 97.2 at the forward's (512, 1024)); below
-        that the r3-tuned shared default stands. Isolated per-kernel
-        sweeps suggested MIXED tilings — measured 26% WORSE composed;
-        see BENCH_ATTENTION.md round-4.
+      bwd_block_q/bwd_block_k: ONE backward tiling (sweep/debug
+        override). When left None the backward auto-tiles: the default
+        fused kernel takes (1024, 1024) fit to the padded length at
+        EVERY length (the r5 composed winner); the split path keeps its
+        r4 rules ((1024, 1024) at padded L >= 4096, the forward tiling
+        below). Isolated per-kernel sweeps suggested MIXED tilings —
+        measured 26% WORSE composed; see BENCH_ATTENTION.md round-4.
       interpret: run the kernels in the Pallas interpreter (CPU testing).
+      bwd_impl: "fused" (default, round 5) — single-pass dQ+dK+dV
+        kernel with HBM dQ partials, 61-118 TFLOP/s fwdbwd at 1k-16k vs
+        the split kernels' 48-97 (BENCH_ATTENTION.md r5); "split" — the
+        r4 two-kernel decomposition (still used per ring visit by
+        ops/ring_flash.py).
 
     Default block sizes come from an on-chip sweep (v5e, causal, D=128,
     scripts/bench_attention.py --sweep): (512, 1024) wins at every length
@@ -478,6 +628,13 @@ def flash_attention(
 
     if bwd_block_q or bwd_block_k:
         dq_blocks = dkv_blocks = (min(bq_c, lq_pad), min(bk_c, lk_pad))
+    elif bwd_impl == "fused":
+        # r5 composed A/B (same-process, scripts/bench_attention.py): the
+        # fused single-pass backward at (1024, 1024) beats the split
+        # kernels at EVERY length — 61/83/109/114/118 TFLOP/s fwdbwd at
+        # 1k/2k/4k/8k/16k vs split's 48/69/90/92/97. Larger blocks fail
+        # Mosaic compile (VMEM); _fit clamps short/odd lengths.
+        dq_blocks = dkv_blocks = (_fit(1024, lq_pad), _fit(1024, lk_pad))
     elif lk_pad >= 4096:
         # r4 sweep THROUGH the real vjp: (1024, 1024) for both backward
         # kernels is the (marginal) winner at L in {4096, 8192} — 89.8 /
@@ -491,13 +648,18 @@ def flash_attention(
     else:
         dq_blocks = dkv_blocks = (block_q, block_k)
 
+    if bwd_impl not in ("split", "fused"):
+        raise ValueError(
+            f"bwd_impl {bwd_impl!r} must be 'split' (two kernels) or "
+            "'fused' (single-pass dQ+dK+dV with HBM dQ partials)"
+        )
     if pad_q or pad_k:
         padq = lambda x: jnp.pad(x, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
         padk = lambda x: jnp.pad(x, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
         out = _flash(
             padq(q), padk(k), padk(v), scale, causal, block_q, block_k, lk,
-            interpret, dq_blocks, dkv_blocks,
+            interpret, dq_blocks, dkv_blocks, bwd_impl,
         )
         return out[:, :lq]
     return _flash(q, k, v, scale, causal, block_q, block_k, lk, interpret,
-                  dq_blocks, dkv_blocks)
+                  dq_blocks, dkv_blocks, bwd_impl)

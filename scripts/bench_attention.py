@@ -152,10 +152,14 @@ def parity_on_device(b=2, h=4, l=512, d=64):
     assert bwd_err < 1e-2 * max(scale_ref, 1.0), (bwd_err, scale_ref)
 
 
-def sweep_bwd():
+def sweep_bwd(bwd_impl: str = "split"):
     """Round-4 sweep (VERDICT r3 weak #3): the backward kernels' tiling at
     L >= 4096, independent of the forward's (512, 1024). fwdbwd numbers
-    include the fixed fwd kernel, so compare rows, not absolutes."""
+    include the fixed fwd kernel, so compare rows, not absolutes.
+    ``bwd_impl`` is pinned EXPLICITLY (default the r4-era split kernels,
+    this sweep's historical subject) because flash_attention's default
+    became "fused" in r5 — pass --sweep-bwd-fused to sweep the fused
+    kernel's tiling instead."""
     b, h, d = 2, 4, 128
     for l in (4096, 8192):
         rows = []
@@ -163,7 +167,7 @@ def sweep_bwd():
             for bk in (512, 1024, 2048):
                 fn = functools.partial(
                     flash_attention, causal=True,
-                    bwd_block_q=bq, bwd_block_k=bk,
+                    bwd_block_q=bq, bwd_block_k=bk, bwd_impl=bwd_impl,
                 )
                 try:
                     dt, tf = bench_impl(
@@ -178,12 +182,16 @@ def sweep_bwd():
             tf, bq, bk = max(rows)
             print(json.dumps({"sweep_bwd_best": {"L": l, "bwd_block_q": bq,
                                                  "bwd_block_k": bk,
+                                                 "bwd_impl": bwd_impl,
                                                  "tflops": tf}}))
 
 
 def main():
     if "--sweep-bwd" in sys.argv:
         sweep_bwd()
+        return
+    if "--sweep-bwd-fused" in sys.argv:
+        sweep_bwd(bwd_impl="fused")
         return
     quick = "--quick" in sys.argv
     parity_on_device()

@@ -29,22 +29,34 @@ import numpy as np
 from bench import measure_roundtrip_s  # noqa: E402  (scripts on path via cwd)
 
 
+def _gpt2_model(max_seq_len=1024, dtype=None, **over):
+    """One GPT-2-small-shaped serving config + init — shared by every
+    measurement here so the stall numbers can never drift to a different
+    model than the tick rate they are combined with."""
+    from pytorch_distributed_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
+        max_seq_len=max_seq_len,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        attention="dense", **over,
+    )
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
 def measure(slots: int = 32, max_new: int = 64) -> dict:
     from pytorch_distributed_tpu.models.generate import (
         generate_ragged,
         ragged_prefill,
     )
-    from pytorch_distributed_tpu.models.transformer import (
-        TransformerConfig,
-        TransformerLM,
-    )
-    cfg = TransformerConfig(
-        vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
-        max_seq_len=1024, dtype=jnp.bfloat16, attention="dense",
-    )
-    params = TransformerLM(cfg).init(
-        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
+
+    cfg, params = _gpt2_model()
 
     rng = np.random.default_rng(0)
     lengths = rng.integers(16, 257, slots).astype(np.int32)
@@ -122,18 +134,8 @@ def measure_admission_stall(slots: int = 32, n: int = 10,
     is what a Poisson trace converges to when the system is kept full.
     """
     from pytorch_distributed_tpu.models.generate import ContinuousBatcher
-    from pytorch_distributed_tpu.models.transformer import (
-        TransformerConfig,
-        TransformerLM,
-    )
 
-    cfg = TransformerConfig(
-        vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
-        max_seq_len=1024, dtype=jnp.bfloat16, attention="dense",
-    )
-    params = TransformerLM(cfg).init(
-        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
+    cfg, params = _gpt2_model()
     b = ContinuousBatcher(cfg, params, n_slots=slots, prefill_bucket=128)
 
     rng = np.random.default_rng(0)
@@ -202,23 +204,13 @@ def measure_tp_virtual(slots: int = 8, tp: int = 2) -> dict:
     import dataclasses
 
     from pytorch_distributed_tpu.models.generate import generate_ragged_tp
-    from pytorch_distributed_tpu.models.transformer import (
-        TransformerConfig,
-        TransformerLM,
-    )
     from pytorch_distributed_tpu.parallel import make_mesh
 
     if len(jax.devices()) < tp:
         return {"serving_tp_error": f"needs {tp} devices"}
-    cfg = TransformerConfig(
-        vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
-        max_seq_len=512, dtype=jnp.float32, attention="dense",
-        model_axis="model", tp_size=tp,
-    )
-    rep = dataclasses.replace(cfg, model_axis=None, tp_size=1)
-    params = TransformerLM(rep).init(
-        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
+    _, params = _gpt2_model(max_seq_len=512, dtype=jnp.float32)
+    cfg, _ = _gpt2_model(max_seq_len=512, dtype=jnp.float32,
+                         model_axis="model", tp_size=tp)
     mesh = make_mesh(jax.devices()[:tp], data_parallel=1, seq_parallel=1,
                      model_parallel=tp)
     rng = np.random.default_rng(0)
