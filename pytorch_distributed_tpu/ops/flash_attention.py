@@ -161,6 +161,37 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret):
     )(q3, k3, v3)
 
 
+def _masked_p_ds(q, k, v, do, lse, delta, *, scale, causal,
+                 q_start, k_start, block_q, block_k, kv_len):
+    """The ONE masked-softmax-gradient block shared by every backward
+    kernel: S = scale·QKᵀ (fp32 accum), the causal+padding mask,
+    P = exp(S − LSE) via ``where`` (not ``*``) so a fully-masked row
+    (LSE = −inf from the forward) yields 0, not inf·0 = NaN — defends
+    offset/cross-attention callers the forward already defends — and
+    dS = P ⊙ (dOVᵀ − Δ)·scale. Keeping it in one place means a masking
+    or NaN-defense fix cannot diverge between bwd_impl='split' and
+    'fused'."""
+    sblk = jax.lax.dot_general(
+        q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block_q, block_k]
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = k_pos < kv_len
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        mask = mask & (k_pos <= q_pos)
+    pblk = jnp.where(mask, jnp.exp(sblk - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = pblk * (dp - delta) * jnp.asarray(scale, jnp.float32)
+    return pblk, ds
+
+
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
@@ -176,33 +207,13 @@ def _bwd_dq_kernel(
     k_start = ki * block_k
 
     def _block():
-        q = q_ref[0]
         k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]  # [block_q, 1]
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(
-            q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        _p, ds = _masked_p_ds(
+            q_ref[0], k, v_ref[0], do_ref[0], lse_ref[0][:, :1],
+            delta_ref[0][:, :1], scale=scale, causal=causal,
+            q_start=q_start, k_start=k_start, block_q=block_q,
+            block_k=block_k, kv_len=kv_len,
         )
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        mask = k_pos < kv_len
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            mask = mask & (k_pos <= q_pos)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [block_q, block_k], fp32
-        # where (not *) so a fully-masked row (lse = -inf from the
-        # forward) yields 0, not inf*0 = NaN — defends offset/cross-
-        # attention callers the forward already defends.
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta) * jnp.asarray(scale, jnp.float32)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -236,34 +247,18 @@ def _bwd_dkv_kernel(
 
     def _block():
         q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(
-            q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+        p, ds = _masked_p_ds(
+            q, k_ref[0], v_ref[0], do, lse_ref[0][:, :1],
+            delta_ref[0][:, :1], scale=scale, causal=causal,
+            q_start=q_start, k_start=k_start, block_q=block_q,
+            block_k=block_k, kv_len=kv_len,
         )
-        mask = k_pos < kv_len
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            mask = mask & (k_pos <= q_pos)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # see dq kernel note
         # dV += P^T dO
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta) * jnp.asarray(scale, jnp.float32)
         # dK += dS^T Q
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -313,32 +308,16 @@ def _bwd_fused_kernel(
     def _block():
         q = q_ref[0]
         k = k_ref[0]
-        v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(
-            q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+        p, ds = _masked_p_ds(
+            q, k, v_ref[0], do, lse_ref[0][:, :1], delta_ref[0][:, :1],
+            scale=scale, causal=causal, q_start=q_start, k_start=k_start,
+            block_q=block_q, block_k=block_k, kv_len=kv_len,
         )
-        mask = k_pos < kv_len
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            mask = mask & (k_pos <= q_pos)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # see dq kernel note
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta) * jnp.asarray(scale, jnp.float32)
         dsc = ds.astype(q.dtype)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             dsc, q, (((0,), (0,)), ((), ())),
@@ -626,6 +605,17 @@ def flash_attention(
             b //= 2
         return max(b, 1)
 
+    def _fit_pair(bq_cand, bk_cand):
+        # auto-tile, guarded (ADVICE r4 #3): odd caller-chosen forward
+        # blocks can make _fit land on a sub-lane-aligned size (e.g. a
+        # non-multiple-of-8 block at padded L >= 4096) that fails Mosaic
+        # compile — fall back to the forward tiling instead.
+        bq_f, bk_f = _fit(bq_cand, lq_pad), _fit(bk_cand, lk_pad)
+        for bb in (bq_f, bk_f):
+            if bb < 128 and bb % 8:
+                return (block_q, block_k)
+        return (bq_f, bk_f)
+
     if bwd_block_q or bwd_block_k:
         dq_blocks = dkv_blocks = (min(bq_c, lq_pad), min(bk_c, lk_pad))
     elif bwd_impl == "fused":
@@ -634,7 +624,7 @@ def flash_attention(
         # kernels at EVERY length — 61/83/109/114/118 TFLOP/s fwdbwd at
         # 1k/2k/4k/8k/16k vs split's 48/69/90/92/97. Larger blocks fail
         # Mosaic compile (VMEM); _fit clamps short/odd lengths.
-        dq_blocks = dkv_blocks = (_fit(1024, lq_pad), _fit(1024, lk_pad))
+        dq_blocks = dkv_blocks = _fit_pair(1024, 1024)
     elif lk_pad >= 4096:
         # r4 sweep THROUGH the real vjp: (1024, 1024) for both backward
         # kernels is the (marginal) winner at L in {4096, 8192} — 89.8 /
@@ -644,7 +634,7 @@ def flash_attention(
         # — (512,1024)/(512,2048) measured 65.5 TFLOP/s, far WORSE;
         # standalone pallas_call timings mislead about the composed
         # pipeline. Composed measurements only.
-        dq_blocks = dkv_blocks = (_fit(1024, lq_pad), _fit(1024, lk_pad))
+        dq_blocks = dkv_blocks = _fit_pair(1024, 1024)
     else:
         dq_blocks = dkv_blocks = (block_q, block_k)
 

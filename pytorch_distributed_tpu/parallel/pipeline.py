@@ -66,8 +66,12 @@ def gpipe(
     # r2→r3 API compatibility: stage_fns written against the 2-arg contract
     # ``(stage_params, x)`` (before mb_idx existed for dropout parity) are
     # accepted and simply don't receive the index. Detected once at trace
-    # time from the signature; *args/**kwargs signatures get the new
-    # 3-arg call.
+    # time from the signature. CONTRACT for opaque signatures (ADVICE r4
+    # #2): ``*args`` callables and C callables whose signature cannot be
+    # inspected are assumed mb_idx-AWARE and receive the 3-arg call
+    # ``(stage_params, x, mb_idx)`` — a legacy 2-arg wrapper written as
+    # ``lambda *a: f(*a[:2])``-style must accept (and may ignore) the
+    # third argument, or expose a real 2-positional signature to opt out.
     import inspect
 
     try:

@@ -564,22 +564,41 @@ class _RawNpz:
     def __getitem__(self, key: str) -> np.ndarray:
         import io
 
-        off, size = self._members[key]
-        bio = io.BytesIO(self._mm[off:min(off + 4096, off + size)])
-        version = np.lib.format.read_magic(bio)
-        if version == (1, 0):
-            shape, fortran, dtype = np.lib.format.read_array_header_1_0(bio)
-        elif version == (2, 0):
-            shape, fortran, dtype = np.lib.format.read_array_header_2_0(bio)
-        else:
-            raise ValueError(f"npy version {version}")
-        if fortran:
-            raise ValueError("fortran-order member")
-        count = int(np.prod(shape)) if shape else 1
-        arr = np.frombuffer(
-            self._mm, dtype=dtype, count=count, offset=off + bio.tell()
-        )
-        return arr.reshape(shape)
+        try:
+            off, size = self._members[key]
+            bio = io.BytesIO(self._mm[off:min(off + 4096, off + size)])
+            version = np.lib.format.read_magic(bio)
+            if version == (1, 0):
+                shape, fortran, dtype = (
+                    np.lib.format.read_array_header_1_0(bio)
+                )
+            elif version == (2, 0):
+                shape, fortran, dtype = (
+                    np.lib.format.read_array_header_2_0(bio)
+                )
+            else:
+                raise ValueError(f"npy version {version}")
+            if fortran:
+                raise ValueError("fortran-order member")
+            if bio.tell() >= 4096:
+                raise ValueError("npy header exceeds the 4096-byte window")
+            count = int(np.prod(shape)) if shape else 1
+            arr = np.frombuffer(
+                self._mm, dtype=dtype, count=count, offset=off + bio.tell()
+            )
+            return arr.reshape(shape)
+        except KeyError:
+            raise
+        except Exception:
+            # Constructor-time validation can't see per-member npy
+            # quirks (format 3.0, oversized headers): fall back to a
+            # lazy np.load for THIS file rather than failing the
+            # restore (ADVICE r4 #1).
+            if not hasattr(self, "_np_fallback"):
+                self._np_fallback = np.load(
+                    self._f.name, allow_pickle=False
+                )
+            return self._np_fallback[key]
 
 
 def load_sharded(

@@ -208,9 +208,10 @@ def measure_tp_virtual(slots: int = 8, tp: int = 2) -> dict:
 
     if len(jax.devices()) < tp:
         return {"serving_tp_error": f"needs {tp} devices"}
-    _, params = _gpt2_model(max_seq_len=512, dtype=jnp.float32)
-    cfg, _ = _gpt2_model(max_seq_len=512, dtype=jnp.float32,
-                         model_axis="model", tp_size=tp)
+    # ONE init with the replicated twin (a TP config cannot init outside
+    # shard_map — tp_reduce's psum has no axis); the TP cfg is a replace
+    rep, params = _gpt2_model(max_seq_len=512, dtype=jnp.float32)
+    cfg = dataclasses.replace(rep, model_axis="model", tp_size=tp)
     mesh = make_mesh(jax.devices()[:tp], data_parallel=1, seq_parallel=1,
                      model_parallel=tp)
     rng = np.random.default_rng(0)

@@ -1,0 +1,213 @@
+"""Measured HBM bytes/step from the profiler's per-memory-space counters.
+
+Closes VERDICT r4 weak #3 / next #8: the ResNet roofline claim previously
+rested on an ANALYTIC-MINIMUM byte count (PERF_NOTES §7's >=49%-of-ceiling
+lower bound). This derives the ACHIEVED number from the trace itself:
+
+- the Chrome-trace JSON's per-op ``bytes_accessed`` is XLA's cost-model
+  figure and DOUBLE-COUNTS on-chip reuse — summing it yields 945 GB/s
+  "achieved", above the physically measured 657 GB/s ceiling, proving it
+  is not DRAM traffic;
+- the ``.xplane.pb`` sidecar carries what the JSON redacts as
+  ``memory_access_breakdown: <opaque bytes>``: per-op (operation_type,
+  memory_space, bytes) tuples. No xplane proto bindings ship in this
+  environment, so this file walks the protobuf WIRE FORMAT generically
+  (field numbers verified against the plane's own stat_metadata table:
+  31=bytes_accessed, 33=memory_access_breakdown, 24=hlo_category) and
+  joins event metadata to per-step execution counts;
+- memory_space 1 is HBM (the tsl op_metrics constant; the other observed
+  space, 3, matches the S(1) scoped/VMEM annotations on the prefetch
+  copies' layouts). Sanity: HBM-only bandwidth must land BELOW the
+  measured ceiling, and it does.
+
+Usage: python scripts/trace_hbm.py <trace_dir>   (a jax.profiler.trace
+output dir; run e.g. bench.py's ResNet step under the profiler first)
+Prints one JSON line: hbm GB/step (read/write), busy ms/step,
+achieved GB/s, and %-of-ceiling against the 657 GB/s measured roofline.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import struct
+import sys
+
+CEILING_GB_S = 657.0  # measured DRAM ceiling (PERF_NOTES §7)
+
+
+def _read_varint(buf, i):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _parse(buf):
+    out = []
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        f, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wt == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wt}")
+        out.append((f, wt, v))
+    return out
+
+
+def _try(buf):
+    try:
+        return _parse(buf)
+    except Exception:
+        return None
+
+
+def analyze(trace_dir: str, steps: int) -> dict:
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb")
+    ))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    data = open(paths[-1], "rb").read()
+
+    tpu = None
+    for f, wt, v in _parse(data):
+        if f == 1 and wt == 2:
+            d: dict = {}
+            for pf, _pwt, pv in _parse(v):
+                d.setdefault(pf, []).append(pv)
+            if d.get(2, [b""])[0].startswith(b"/device:TPU"):
+                tpu = d
+                break
+    if tpu is None:
+        raise ValueError("no TPU plane in the xplane")
+
+    # Trust-but-verify the hardcoded stat ids against the plane's own
+    # metadata table (a profiler version could renumber them).
+    stat_names = {}
+    for sm in tpu.get(5, []):
+        kv = {f: v for f, _wt, v in _parse(sm)}
+        md = {f: v for f, _wt, v in _parse(kv[2])}
+        stat_names[kv.get(1, md.get(1))] = md.get(2, b"?").decode()
+    for sid, want in ((31, "bytes_accessed"),
+                      (33, "memory_access_breakdown"),
+                      (24, "hlo_category")):
+        if stat_names.get(sid) != want:
+            raise ValueError(
+                f"stat id {sid} is {stat_names.get(sid)!r}, expected "
+                f"{want!r} — profiler renumbered; update this parser"
+            )
+
+    # event metadata: id -> (breakdown entries, cost-model bytes)
+    meta: dict = {}
+    for em in tpu.get(4, []):
+        kv = {f: v for f, _wt, v in _parse(em)}
+        md: dict = {}
+        for f, wt, v in _parse(kv[2]):
+            md.setdefault(f, []).append((wt, v))
+        mid = md.get(1, [(0, kv.get(1))])[0][1]
+        brk, ba = [], 0
+        for f, vals in md.items():
+            if f in (1, 2, 3):
+                continue
+            for wt, v in vals:
+                if wt != 2 or not isinstance(v, bytes):
+                    continue
+                st = _try(v)
+                if not st:
+                    continue
+                sd = {sf: sv for sf, _swt, sv in st}
+                if sd.get(1) == 33:
+                    for sf, swt, sv in st:
+                        if swt == 2 and sf != 1:
+                            for _a, b, c in _try(sv) or []:
+                                if b == 2:
+                                    ent = {x: z for x, _y, z in
+                                           _try(c) or []}
+                                    brk.append((ent.get(1), ent.get(2),
+                                                ent.get(3, 0)))
+                elif sd.get(1) == 31:
+                    vals31 = [sv for sf, swt, sv in st
+                              if sf != 1 and swt == 0]
+                    ba = vals31[0] if vals31 else 0
+        meta[mid] = (brk, ba)
+
+    # XLA Ops line: execution counts + busy-time union
+    ops_line = None
+    for ln in tpu.get(3, []):
+        lf = _parse(ln)
+        if [v for f, _wt, v in lf if f == 2][0] == b"XLA Ops":
+            ops_line = lf
+            break
+    execs = collections.Counter()
+    intervals = []
+    for e in [v for f, _wt, v in ops_line if f == 4]:
+        ed = {f: v for f, _wt, v in _parse(e)}
+        execs[ed.get(1)] += 1
+        off, dur = ed.get(2, 0), ed.get(3, 0)
+        intervals.append((off, off + dur))
+    intervals.sort()
+    busy = 0
+    cs, ce = intervals[0]
+    for s, e2 in intervals[1:]:
+        if s > ce:
+            busy += ce - cs
+            cs, ce = s, e2
+        else:
+            ce = max(ce, e2)
+    busy += ce - cs
+    busy_s = busy / 1e12 / steps  # device ps → s
+
+    space = collections.Counter()
+    rw = collections.Counter()
+    model_bytes = 0
+    for mid, cnt in execs.items():
+        brk, ba = meta.get(mid, ([], 0))
+        model_bytes += ba * cnt
+        for otype, sp, byts in brk:
+            space[sp] += byts * cnt
+            rw[(otype, sp)] += byts * cnt
+
+    hbm = space.get(1, 0) / steps
+    out = {
+        "hbm_gb_per_step": round(hbm / 1e9, 2),
+        "hbm_read_gb": round(rw.get((1, 1), 0) / steps / 1e9, 2),
+        "hbm_write_gb": round(rw.get((2, 1), 0) / steps / 1e9, 2),
+        "onchip_gb_per_step": round(space.get(3, 0) / steps / 1e9, 2),
+        "cost_model_gb_per_step": round(model_bytes / steps / 1e9, 2),
+        "busy_ms_per_step": round(busy_s * 1e3, 2),
+        "achieved_hbm_gb_s": round(hbm / 1e9 / busy_s, 1),
+        "pct_of_ceiling": round(hbm / 1e9 / busy_s / CEILING_GB_S * 100, 1),
+    }
+    if out["achieved_hbm_gb_s"] > CEILING_GB_S * 1.05:
+        raise ValueError(
+            f"HBM-space bandwidth {out['achieved_hbm_gb_s']} exceeds the "
+            f"measured ceiling {CEILING_GB_S} — the space mapping is "
+            "wrong for this profiler version; do not publish"
+        )
+    return out
+
+
+if __name__ == "__main__":
+    td = sys.argv[1] if len(sys.argv) > 1 else "/tmp/resnet_trace_r5"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    print(json.dumps(analyze(td, steps)))
