@@ -112,7 +112,7 @@ class SuspendableTrainer:
         in-flight save — every rank calls this at the same step, so the
         collective ordering matches the suspend/best paths."""
         every = getattr(self.config, "save_every_n_steps", 0)
-        if not every or (step + 1) % every:
+        if every <= 0 or (step + 1) % every:  # negative = off, like 0
             return
         gstep = int(np.asarray(jax.device_get(self.state.step)))
         self.ckpt.save_step_sharded(

@@ -217,4 +217,16 @@ def parse_lm_args(description: str) -> argparse.Namespace:
                         "BENCH_PP.md)")
     p.add_argument("--model-parallel", type=int, default=1,
                    help="tensor-parallel degree")
+    p.add_argument("--vocab-parallel", action="store_true",
+                   help="Megatron vocab parallelism: shard wte + lm_head "
+                        "vocab dims over the TP axis (needs "
+                        "--model-parallel > 1; ~-44%% per-device state at "
+                        "tp=2, BENCH_LM.md r5)")
+    p.add_argument("--save-every-n-steps", type=int, default=0,
+                   help="step-interval durability: non-blocking sharded "
+                        "step-<N>.ckpt saves every N steps (0 = off, the "
+                        "reference's suspend/best-only policy)")
+    p.add_argument("--keep-last-ckpts", type=int, default=3,
+                   help="retention for --save-every-n-steps (completed "
+                        "checkpoints kept; resume picks the newest)")
     return p.parse_args()

@@ -125,6 +125,7 @@ def main() -> None:
             attention="ring" if sp > 1 else "dense",
             model_axis="model" if tp > 1 else None,
             tp_size=tp,
+            vocab_parallel=args.vocab_parallel,
             dropout=args.dropout,
             ring_layout=args.ring_layout if sp > 1 else "contiguous",
         )
@@ -142,7 +143,24 @@ def main() -> None:
             attention=attention,
             model_axis="model" if tp > 1 else None,
             tp_size=tp,
+            vocab_parallel=args.vocab_parallel,
             ring_layout=args.ring_layout if sp > 1 else "contiguous",
+        )
+    if args.vocab_parallel and args.pipeline_stages:
+        raise SystemExit(
+            "--vocab-parallel does not compose with --pipeline-stages "
+            "(PPEmbed/PPHead are stage-replicated; train/pp.py)"
+        )
+    if args.vocab_parallel and tp <= 1:
+        raise SystemExit("--vocab-parallel needs --model-parallel > 1")
+    if args.save_every_n_steps < 0:
+        raise SystemExit(
+            f"--save-every-n-steps must be >= 0 (0 = off), got "
+            f"{args.save_every_n_steps}"
+        )
+    if args.keep_last_ckpts < 1:
+        raise SystemExit(
+            f"--keep-last-ckpts must be >= 1, got {args.keep_last_ckpts}"
         )
 
     cfg = LMTrainerConfig(
@@ -157,6 +175,8 @@ def main() -> None:
         fsdp=args.fsdp,
         pipeline_stages=args.pipeline_stages,
         pp_microbatches=args.pp_microbatches,
+        save_every_n_steps=args.save_every_n_steps,
+        keep_last_ckpts=args.keep_last_ckpts,
     )
     trainer = LMTrainer(model_cfg, train_ds, val_ds, cfg, mesh=mesh,
                         suspend_watcher=SuspendWatcher())
