@@ -46,12 +46,31 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.ops.attention import NEG_INF
 from pytorch_distributed_tpu.ops.flash_attention import (
     _flash_bwd,
+    _flash_bwd_fused,
     _flash_fwd,
     _from3,
     _to3,
     compute_delta,
 )
 from pytorch_distributed_tpu.parallel.mesh import SEQ_AXIS
+
+
+def _visit_bwd(q3, k_cur, v_cur, o3, lse3, do3, scale, causal_block,
+               block_q, block_k, interpret, delta3, bwd_impl):
+    """One visiting shard's (dQ-contribution, dK, dV) — the r5 fused
+    single-pass kernel by default (5 big matmuls + one input pass per
+    visit vs the split kernels' 7 and two; +20-29% measured standalone,
+    BENCH_ATTENTION.md r5), the split pair via bwd_impl='split'."""
+    if bwd_impl == "fused":
+        return _flash_bwd_fused(
+            q3, k_cur, v_cur, o3, lse3, do3, scale, causal_block,
+            (block_q, block_k), k_cur.shape[1], interpret, delta3=delta3,
+        )
+    return _flash_bwd(
+        q3, k_cur, v_cur, o3, lse3, do3, scale, causal_block,
+        (block_q, block_k), (block_q, block_k), k_cur.shape[1],
+        interpret, delta3=delta3,
+    )
 
 
 def _fit_block(requested: int, length: int) -> int:
@@ -82,18 +101,19 @@ def _shard_fwd(q3, k3, v3, scale, causal_block, block_q, block_k, interpret):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
 def _ring_flash(q, k, v, axis, causal, scale, block_q, block_k, interpret,
-                layout):
+                layout, bwd_impl):
     out, _ = _ring_flash_fwd(
-        q, k, v, axis, causal, scale, block_q, block_k, interpret, layout
+        q, k, v, axis, causal, scale, block_q, block_k, interpret, layout,
+        bwd_impl,
     )
     return out
 
 
 def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret,
-                    layout):
+                    layout, bwd_impl):
     if layout == "zigzag":
         return _ring_flash_zigzag_fwd(
             q, k, v, axis, scale, block_q, block_k, interpret
@@ -166,10 +186,10 @@ def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret,
 
 
 def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret, layout,
-                    res, g):
+                    bwd_impl, res, g):
     if layout == "zigzag":
         return _ring_flash_zigzag_bwd(
-            axis, scale, block_q, block_k, interpret, res, g
+            axis, scale, block_q, block_k, interpret, res, g, bwd_impl
         )
     q, k, v, o3, lse = res
     b, lq, h, d = q.shape
@@ -182,10 +202,9 @@ def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret, layout,
     perm = [(i, (i + 1) % s) for i in range(s)]
 
     def shard_bwd(k_cur, v_cur, causal_block):
-        return _flash_bwd(
+        return _visit_bwd(
             q3, k_cur, v_cur, o3, lse3, do3, scale, causal_block,
-            (block_q, block_k), (block_q, block_k), k_cur.shape[1],
-            interpret, delta3=delta3,
+            block_q, block_k, interpret, delta3, bwd_impl,
         )
 
     def fold(dq_acc, dk_cur, dv_cur, k_cur, v_cur, step):
@@ -340,7 +359,8 @@ def _ring_flash_zigzag_fwd(q, k, v, axis, scale, block_q, block_k, interpret):
     return _from3(o3, b, h), (q, k, v, o3, lse)
 
 
-def _ring_flash_zigzag_bwd(axis, scale, block_q, block_k, interpret, res, g):
+def _ring_flash_zigzag_bwd(axis, scale, block_q, block_k, interpret, res, g,
+                           bwd_impl):
     """Zigzag backward: per-pair FlashAttention-2 kernels with the global
     LSE; dq accumulates per local q chunk, dk/dv accumulators travel with
     their shard (same traveling scheme as the contiguous backward) with
@@ -363,10 +383,9 @@ def _ring_flash_zigzag_bwd(axis, scale, block_q, block_k, interpret, res, g):
 
     def pair_bwd(which, kc, vc, causal_block):
         qc, oc, lsec, doc, dc = chunks[which]
-        return _flash_bwd(
+        return _visit_bwd(
             qc, kc, vc, oc, lsec, doc, scale, causal_block,
-            (block_q, block_k), (block_q, block_k), kc.shape[1],
-            interpret, delta3=dc,
+            block_q, block_k, interpret, dc, bwd_impl,
         )
 
     def fold(dq_acc, dkv_cur, k_cur, v_cur, step):
@@ -463,10 +482,16 @@ def ring_flash_attention(
     axis: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 512,
+    # (1024, 1024): the r5 composed on-chip A/B through the ring path —
+    # 90.1/106.8 TFLOP/s fwdbwd at L 4096/8192 vs 87.8/103.4 at the old
+    # (512, 1024) (both with the fused per-visit backward; the split
+    # kernels measured 84-95 on the same harness). _fit_block clamps for
+    # small shards.
+    block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool | None = None,
     layout: str = "contiguous",
+    bwd_impl: str = "fused",
 ) -> jax.Array:
     """Ring attention with Pallas flash kernels per visiting shard (call
     under shard_map; same contract as ``parallel.sequence.ring_attention``:
@@ -499,12 +524,17 @@ def ring_flash_attention(
             )
         if lq % 2:
             raise ValueError(f"zigzag needs an even shard length, got {lq}")
+    if bwd_impl not in ("split", "fused"):
+        raise ValueError(
+            f"bwd_impl {bwd_impl!r} must be 'split' or 'fused'"
+        )
+    if layout == "zigzag":
         c = lq // 2
         block_q = _fit_block(block_q, c)
         block_k = _fit_block(block_k, c)
         return _ring_flash(q, k, v, axis, True, scale, block_q, block_k,
-                           interpret, "zigzag")
+                           interpret, "zigzag", bwd_impl)
     block_q = _fit_block(block_q, lq)
     block_k = _fit_block(block_k, lk)
     return _ring_flash(q, k, v, axis, causal, scale, block_q, block_k,
-                       interpret, "contiguous")
+                       interpret, "contiguous", bwd_impl)

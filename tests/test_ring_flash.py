@@ -215,3 +215,43 @@ def test_zigzag_validations():
     with pytest.raises(ValueError, match="unknown layout"):
         ring_flash_attention(q, k, v, causal=True, layout="striped",
                              interpret=True)
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_ring_flash_split_backward_escape_hatch(devices8, layout):
+    """bwd_impl='split' (the documented fallback) must produce the same
+    gradients as the fused default — the split argument threading through
+    _visit_bwd is otherwise exercised by no test."""
+    mesh = make_mesh(devices8, data_parallel=2, seq_parallel=4)
+    q, k, v = qkv()
+    sh = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    if layout == "zigzag":
+        from pytorch_distributed_tpu.parallel.sequence import zigzag_shard
+
+        q, k, v = (
+            jnp.asarray(zigzag_shard(np.asarray(x), 4, axis=1))
+            for x in (q, k, v)
+        )
+
+    def fn(impl):
+        f = shard_map(
+            functools.partial(ring_flash_attention, causal=True,
+                              block_q=16, block_k=16, interpret=True,
+                              layout=layout, bwd_impl=impl),
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, SEQ_AXIS),) * 3,
+            out_specs=P(DATA_AXIS, SEQ_AXIS),
+            check_vma=False,
+        )
+        return lambda q_, k_, v_: jnp.sum(f(q_, k_, v_) ** 2)
+
+    args = tuple(jax.device_put(x, sh) for x in (q, k, v))
+    g_f = jax.grad(fn("fused"), argnums=(0, 1, 2))(*args)
+    g_s = jax.grad(fn("split"), argnums=(0, 1, 2))(*args)
+    for name, a, b in zip("qkv", g_f, g_s):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6,
+            err_msg=f"d{name}",
+        )
+    with pytest.raises(ValueError, match="bwd_impl"):
+        ring_flash_attention(q, k, v, causal=True, bwd_impl="nope")
