@@ -184,6 +184,8 @@ def bench_lm_training() -> dict:
     r = bench_lm.bench("flash", batch=8, seq=1024, iters=10, quiet=True)
     return {
         "lm_tokens_per_s": r["tokens_per_s"],
+        "lm_tokens_per_s_min": r["tokens_per_s_min"],
+        "lm_tokens_per_s_max": r["tokens_per_s_max"],
         "lm_mfu": r["mfu"],
         "lm_params_m": r["params_m"],
         "lm_attention": "flash",

@@ -76,15 +76,22 @@ def bench(attention: str, batch: int, seq: int, iters: int = 20,
         state, m = step(state, b)
     loss = float(m["loss"])
     assert np.isfinite(loss), loss
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, b)
-    float(m["loss"])
-    dt = time.perf_counter() - t0
-    dt = max(dt - measure_roundtrip_s(), dt / 2) / iters
-
-    tokens_per_step = batch * seq
-    tok_s = tokens_per_step / dt
+    # median of 3 windows (the BENCH_TABLE spread policy — a single
+    # window samples the tunnel's weather); ONE roundtrip estimate for
+    # all windows (per-window re-measurement costs ~4 tunnel hops each
+    # and makes windows subtract inconsistent estimates)
+    rt = measure_roundtrip_s()
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, b)
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+        dt = max(dt - rt, dt / 2) / iters
+        rates.append(batch * seq / dt)
+    tok_s = float(np.median(rates))
+    dt = batch * seq / tok_s
     # standard estimate: fwd+bwd ≈ 6 FLOPs/param/token + attention term
     attn_flops = 12 * cfg.num_layers * cfg.embed_dim * seq  # per token
     mfu = (6 * n_params + attn_flops) * tok_s / (PEAK_TFLOPS * 1e12)
@@ -92,6 +99,8 @@ def bench(attention: str, batch: int, seq: int, iters: int = 20,
         "model": "gpt2-small-shaped", "params_m": round(n_params / 1e6, 1),
         "attention": attention, "batch": batch, "seq": seq,
         "step_ms": round(dt * 1e3, 2), "tokens_per_s": round(tok_s),
+        "tokens_per_s_min": round(min(rates)),
+        "tokens_per_s_max": round(max(rates)),
         "mfu": round(mfu, 3), "loss": round(loss, 3),
         "device": str(jax.devices()[0]),
     }
