@@ -1,0 +1,35 @@
+"""jaxlint — in-tree static analysis for SPMD/jit correctness hazards.
+
+The classic failure modes of the pjit/shard_map stack are silent: a
+mistyped collective axis trains on wrong math, a Python branch on a traced
+value recompiles every step, a stray ``float()`` in the hot loop syncs the
+device each iteration, a partition rule that matches nothing leaves a
+parameter replicated. This package catches them before they cost a run:
+
+- ``run_lint`` / ``scripts/jaxlint.py``: AST rules over the package
+  (collective-axis, recompile hazards, host transfers, precision casts);
+- ``partition_coverage.check_partition_coverage``: cross-checks the
+  partition rule tables in ``parallel/``/``train/lm.py`` against real
+  model parameter trees;
+- ``guards``: runtime companions (``no_recompile``) that wrap a train step
+  and assert-fail on jit cache growth or host transfers after warmup.
+
+Rules and the ``# jaxlint: disable=<rule>`` suppression syntax are
+documented in ANALYSIS.md at the repo root.
+"""
+
+from pytorch_distributed_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintContext,
+    ParsedModule,
+    all_rule_ids,
+    load_baseline,
+    parse_file,
+    run_lint,
+    split_baselined,
+)
+from pytorch_distributed_tpu.analysis.guards import (  # noqa: F401
+    GuardStats,
+    GuardViolation,
+    no_recompile,
+)

@@ -1,0 +1,120 @@
+"""Shared AST helpers for the jaxlint rules (stdlib only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def terminal_name(call: ast.Call) -> Optional[str]:
+    """Last component of the callee ('psum' for jax.lax.psum / lax.psum)."""
+    d = call_name(call)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def import_map(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> fully-qualified origin for module-level imports.
+
+    ``import jax.numpy as jnp`` -> {'jnp': 'jax.numpy'};
+    ``from jax import lax`` -> {'lax': 'jax.lax'};
+    ``from x.y import f as g`` -> {'g': 'x.y.f'}.
+    """
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def walk_functions(
+    tree: ast.Module,
+) -> Iterator[Tuple[ast.FunctionDef, List[ast.FunctionDef]]]:
+    """Yield (function def, enclosing def stack outermost-first)."""
+
+    def visit(node: ast.AST, stack: List[ast.FunctionDef]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, list(stack)
+                yield from visit(child, stack + [child])
+            else:
+                yield from visit(child, stack)
+
+    yield from visit(tree, [])
+
+
+def param_names(fn: ast.FunctionDef) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def get_kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def get_arg(call: ast.Call, pos: int, kwname: str) -> Optional[ast.expr]:
+    """Argument by position-or-keyword (how the collective axis args bind)."""
+    kw = get_kwarg(call, kwname)
+    if kw is not None:
+        return kw
+    if len(call.args) > pos and not any(
+        isinstance(a, ast.Starred) for a in call.args[: pos + 1]
+    ):
+        return call.args[pos]
+    return None
+
+
+def int_constants(node: ast.expr) -> Optional[List[int]]:
+    """[ints] for an int literal or tuple/list of int literals, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                vals.append(e.value)
+            else:
+                return None
+        return vals
+    return None
+
+
+def assigned_name_targets(node: ast.stmt) -> List[str]:
+    out: List[str] = []
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.append(t.id)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if isinstance(node.target, ast.Name):
+            out.append(node.target.id)
+    return out

@@ -1,0 +1,281 @@
+"""jaxlint core: findings, suppression comments, module parsing, the runner.
+
+Pure stdlib (ast + re) — importing this module must never require jax, so
+the linter can run in a bare CI container. Rules that DO need a live jax
+(partition coverage) live in ``partition_coverage.py`` and degrade to a
+skip when the import fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Mesh axes assumed when the scanned tree declares none (the canonical
+# (data, seq, model) grid of parallel/mesh.py); axes declared via module
+# level ``<NAME>_AXIS = "<axis>"`` constants are unioned in per run.
+DEFAULT_MESH_AXES = ("data", "seq", "model")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\- ]+?)(?:\s+--\s*(.*))?\s*$"
+)
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*jaxlint:\s*disable-file=([A-Za-z0-9_,\- ]+?)(?:\s+--\s*(.*))?\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint result: ``file:line: rule severity: message``."""
+
+    rule: str
+    severity: str  # "error" | "warning"
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.severity}: {self.message}"
+
+
+@dataclasses.dataclass
+class ParsedModule:
+    path: str  # repo-relative, forward slashes
+    abspath: str
+    source: str
+    lines: List[str]
+    tree: ast.Module
+    # line number -> set of suppressed rule names ("all" suppresses any)
+    suppressions: Dict[int, Set[str]]
+    file_suppressions: Set[str]
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        rules = self.suppressions.get(line, ())
+        return rule in rules or "all" in rules
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Shared state for all rules over one run."""
+
+    modules: List[ParsedModule]
+    mesh_axes: Set[str]
+    # *_AXIS constant name -> axis string, unioned over all scanned modules
+    axis_constants: Dict[str, str]
+
+
+def _parse_suppressions(lines: Sequence[str]):
+    per_line: Dict[int, Set[str]] = {}
+    file_level: Set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            file_level.update(r.strip() for r in m.group(1).split(",") if r.strip())
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, file_level
+
+
+def parse_file(abspath: str, rel_root: Optional[str] = None) -> ParsedModule:
+    with open(abspath, "r", encoding="utf-8") as f:
+        source = f.read()
+    rel = (
+        os.path.relpath(abspath, rel_root) if rel_root else abspath
+    ).replace(os.sep, "/")
+    lines = source.splitlines()
+    per_line, file_level = _parse_suppressions(lines)
+    return ParsedModule(
+        path=rel,
+        abspath=os.path.abspath(abspath),
+        source=source,
+        lines=lines,
+        tree=ast.parse(source, filename=abspath),
+        suppressions=per_line,
+        file_suppressions=file_level,
+    )
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", "build")
+                )
+                out.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def collect_axis_constants(modules: Sequence[ParsedModule]) -> Dict[str, str]:
+    """Module-level ``FOO_AXIS = "name"`` assignments across the tree."""
+    consts: Dict[str, str] = {}
+    for mod in modules:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.endswith("_AXIS"):
+                    consts[tgt.id] = node.value.value
+    return consts
+
+
+Rule = Callable[[ParsedModule, LintContext], List[Finding]]
+
+
+def default_rules() -> List[Rule]:
+    from pytorch_distributed_tpu.analysis.rules_collectives import (
+        check_collective_axes,
+    )
+    from pytorch_distributed_tpu.analysis.rules_host_transfer import (
+        check_host_transfers,
+    )
+    from pytorch_distributed_tpu.analysis.rules_precision import (
+        check_precision_casts,
+    )
+    from pytorch_distributed_tpu.analysis.rules_recompile import (
+        check_recompile_hazards,
+    )
+
+    return [
+        check_collective_axes,
+        check_recompile_hazards,
+        check_host_transfers,
+        check_precision_casts,
+    ]
+
+
+def all_rule_ids() -> List[Tuple[str, str, str]]:
+    """(rule id, severity, one-line description) for --list-rules."""
+    return [
+        ("collective-axis", "error",
+         "collective uses an axis name no mesh/shard_map declares"),
+        ("collective-axis-literal", "warning",
+         "collective spells a mesh axis as a string literal instead of the "
+         "shared *_AXIS constant"),
+        ("collective-axis-inconsistent", "warning",
+         "same collective op on the same operand uses two different axis "
+         "names in one function"),
+        ("recompile-traced-branch", "error",
+         "Python if/while on a traced argument of a jit-compiled function"),
+        ("recompile-jit-call", "warning",
+         "jax.jit(...)(...) invoked immediately inside a function — the "
+         "compile cache is discarded every call"),
+        ("recompile-mutable-closure", "warning",
+         "jit-compiled function closes over a module-level mutable that the "
+         "module mutates elsewhere"),
+        ("recompile-static-argnums", "error",
+         "static_argnums out of range, overlapping donate_argnums, or "
+         "marking a non-hashable (list/dict-default) parameter"),
+        ("host-transfer", "error",
+         "float()/np.asarray()/.item()/device_get reachable from a compiled "
+         "train-step body"),
+        ("partition-coverage", "error",
+         "partition rule table leaves a shardable parameter replicated, or "
+         "contains a rule matching no parameter"),
+        ("precision-cast", "warning",
+         "literal f32/bf16 cast in ops/ outside ops/precision.py policy "
+         "helpers"),
+    ]
+
+
+def run_lint(
+    paths: Sequence[str],
+    rel_root: Optional[str] = None,
+    rules: Optional[Sequence[Rule]] = None,
+    extra_axes: Sequence[str] = (),
+) -> List[Finding]:
+    """Parse ``paths`` (files or directories) and run every rule.
+
+    Returns findings with suppression comments already applied, sorted by
+    (path, line). Baseline filtering is the caller's job
+    (``split_baselined``) so tests can see the raw findings.
+    """
+    files = iter_python_files(paths)
+    modules = [parse_file(f, rel_root) for f in files]
+    consts = collect_axis_constants(modules)
+    axes = set(DEFAULT_MESH_AXES) | set(consts.values()) | set(extra_axes)
+    ctx = LintContext(modules=modules, mesh_axes=axes, axis_constants=consts)
+    by_path = {m.path: m for m in modules}
+    findings: Dict[Tuple[str, str, int], Finding] = {}
+    for rule in rules if rules is not None else default_rules():
+        for mod in modules:
+            for f in rule(mod, ctx):
+                # cross-module rules attribute findings to the file the
+                # defect lives in — check suppressions there, and dedupe
+                # sites reachable from several roots
+                owner = by_path.get(f.path, mod)
+                if owner.is_suppressed(f.rule, f.line):
+                    continue
+                findings.setdefault((f.rule, f.path, f.line), f)
+    return sorted(
+        findings.values(), key=lambda f: (f.path, f.line, f.rule)
+    )
+
+
+# ---- baseline --------------------------------------------------------------
+#
+# Pre-existing, reviewed findings live in a JSON baseline so the CLI exits 0
+# on the shipped tree while any NEW finding still fails CI. Entries match on
+# (rule, file, stripped source line content) — not line numbers — so they
+# survive unrelated edits to the same file; every entry carries a human
+# reason.
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data["findings"] if isinstance(data, dict) else data
+    for e in entries:
+        for key in ("rule", "file", "line_content", "reason"):
+            if key not in e:
+                raise ValueError(f"baseline entry missing {key!r}: {e}")
+    return entries
+
+
+def split_baselined(
+    findings: Sequence[Finding],
+    entries: Sequence[dict],
+    sources: Dict[str, Sequence[str]],
+) -> Tuple[List[Finding], List[Finding]]:
+    """Partition findings into (new, baselined).
+
+    ``sources`` maps repo-relative path -> source lines, used to compare a
+    finding's line content against the baseline entry.
+    """
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        lines = sources.get(f.path, ())
+        content = (
+            lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        )
+        matched = any(
+            e["rule"] == f.rule
+            and e["file"] == f.path
+            and e["line_content"] == content
+            for e in entries
+        )
+        (old if matched else new).append(f)
+    return new, old

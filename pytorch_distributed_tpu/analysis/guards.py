@@ -1,0 +1,142 @@
+"""Runtime companions to the static lint: catch what the AST cannot see.
+
+``no_recompile`` wraps an already-jitted step function and turns the two
+silent per-step perf killers into hard failures after a warmup window:
+
+- **recompiles**: the jit cache must stop growing once the step has seen
+  its steady-state shapes/dtypes (warmup covers the first trace and a
+  donation/layout retrace). Any later cache miss raises
+  ``GuardViolation`` naming the step at which it happened.
+- **host transfers**: after warmup every call runs under
+  ``jax.transfer_guard("disallow")`` — an *implicit* transfer (the
+  classic bug: a numpy batch sneaking into the compiled step, re-paying
+  H2D every iteration) raises immediately, while explicit
+  ``device_put``/``device_get``/``float()`` conversions outside the step
+  stay legal (those inside the step's call tree are the static
+  ``host-transfer`` rule's jurisdiction).
+
+Usage::
+
+    step = analysis.no_recompile(make_lm_train_step(mesh, ...))
+    for batch in loader:
+        state, metrics = step(state, batch)   # raises on hazard growth
+    step.stats  # GuardStats(calls=..., cache_size=..., recompiles=...)
+
+The multihost capability probe (``backend_supports_multiprocess``) lives
+here too: the jaxlib CPU backend cannot compile cross-process collectives
+at all ("Multiprocess computations aren't implemented on the CPU
+backend"), which is the triaged root cause of the xfail'd
+``tests/test_multihost.py`` cases — see ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+
+class GuardViolation(AssertionError):
+    """A runtime hazard the static lint cannot prove: a recompile or a
+    host transfer after the warmup window."""
+
+
+@dataclasses.dataclass
+class GuardStats:
+    calls: int = 0
+    warmup_steps: int = 2
+    cache_size: Optional[int] = None
+    recompiles_after_warmup: int = 0
+
+
+def _jit_cache_size(fn) -> Optional[int]:
+    probe = getattr(fn, "_cache_size", None)
+    if callable(probe):
+        try:
+            return int(probe())
+        except Exception:
+            return None
+    return None
+
+
+def no_recompile(
+    step_fn: Callable[..., Any],
+    warmup_steps: int = 2,
+    guard_transfers: bool = True,
+) -> Callable[..., Any]:
+    """Wrap a jitted step: assert-fail on cache growth or implicit host
+    transfers after ``warmup_steps`` calls.
+
+    ``step_fn`` must be the object ``jax.jit`` returned (it carries the
+    compile-cache probe); wrapping an arbitrary Python function would have
+    nothing to measure and raises ``TypeError`` up front.
+    """
+    import jax
+
+    if _jit_cache_size(step_fn) is None:
+        raise TypeError(
+            "no_recompile needs the jit-compiled callable itself (the "
+            "object jax.jit returned); got "
+            f"{getattr(step_fn, '__name__', step_fn)!r} with no jit cache "
+            "to watch"
+        )
+    stats = GuardStats(warmup_steps=warmup_steps)
+
+    @functools.wraps(step_fn)
+    def guarded(*args, **kwargs):
+        stats.calls += 1
+        armed = stats.calls > warmup_steps
+        guard = (
+            jax.transfer_guard("disallow")
+            if (armed and guard_transfers)
+            else contextlib.nullcontext()
+        )
+        try:
+            with guard:
+                out = step_fn(*args, **kwargs)
+        except Exception as e:  # re-raise transfer-guard trips as ours
+            if "transfer" in type(e).__name__.lower() or "Disallowed" in str(e):
+                raise GuardViolation(
+                    f"implicit host transfer inside the step at call "
+                    f"{stats.calls} (after {warmup_steps} warmup steps): "
+                    f"{e}"
+                ) from e
+            raise
+        size = _jit_cache_size(step_fn)
+        if size is not None:
+            if (
+                armed
+                and stats.cache_size is not None
+                and size > stats.cache_size
+            ):
+                stats.recompiles_after_warmup += size - stats.cache_size
+                raise GuardViolation(
+                    f"jit cache grew {stats.cache_size} -> {size} at call "
+                    f"{stats.calls} (after {warmup_steps} warmup steps): "
+                    f"the step retraced — look for shape/dtype drift in "
+                    f"the batch, or Python values baked into the closure"
+                )
+            stats.cache_size = size
+        return out
+
+    guarded.stats = stats
+    return guarded
+
+
+def backend_supports_multiprocess() -> bool:
+    """True when the active jax backend can compile multi-process
+    computations. The stock jaxlib CPU backend cannot (XlaRuntimeError:
+    "Multiprocess computations aren't implemented on the CPU backend"),
+    so localhost 2-process rendezvous tests xfail there — probing for
+    real requires spawning a second process, so this only rules out the
+    known-incapable case."""
+    import jax
+
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        return False
+    if platform == "cpu":
+        return False
+    return True

@@ -1,0 +1,231 @@
+"""Rule: collective-axis — every lax collective names a declared mesh axis.
+
+A mistyped axis name inside ``shard_map`` is the worst kind of SPMD bug:
+jax raises only at trace time IF the axis is unbound, but a name that
+happens to bind to the *wrong* axis (e.g. ``"model"`` where the gradient
+combine meant ``"data"``) trains on wrong math with no error at all. This
+rule checks, fully statically:
+
+- ``collective-axis`` (error): the axis argument of every
+  ``jax.lax.psum/pmean/pmax/pmin/psum_scatter/all_gather/ppermute/
+  all_to_all/axis_index`` call resolves to a name declared by the mesh
+  (``*_AXIS`` constants / ``Mesh(axis_names=...)``), an enclosing
+  ``pmap(axis_name=...)``, or a ``shard_map`` in the same module.
+- ``collective-axis-literal`` (warning): the axis is spelled as a raw
+  string literal where a shared ``*_AXIS`` constant exists — the exact
+  situation that lets call sites drift apart across hosts/modules.
+- ``collective-axis-inconsistent`` (warning): within one function, the
+  same collective op applied to the same operand resolves to two different
+  axis sets — the "same logical collective, different axis name" hazard.
+
+Axis arguments that cannot be resolved statically (values threaded through
+call chains) are skipped, not guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from pytorch_distributed_tpu.analysis._astutil import (
+    dotted,
+    get_arg,
+    get_kwarg,
+    import_map,
+    terminal_name,
+)
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ParsedModule,
+)
+
+# op name -> (positional index of the axis argument, its keyword name)
+COLLECTIVES: Dict[str, Tuple[int, str]] = {
+    "psum": (1, "axis_name"),
+    "pmean": (1, "axis_name"),
+    "pmax": (1, "axis_name"),
+    "pmin": (1, "axis_name"),
+    "psum_scatter": (1, "axis_name"),
+    "all_gather": (1, "axis_name"),
+    "ppermute": (1, "axis_name"),
+    "all_to_all": (1, "axis_name"),
+    "pshuffle": (1, "axis_name"),
+    "axis_index": (0, "axis_name"),
+}
+
+
+def _is_lax_collective(call: ast.Call, imports: Dict[str, str]) -> Optional[str]:
+    name = terminal_name(call)
+    if name not in COLLECTIVES:
+        return None
+    d = dotted(call.func)
+    if d is None:
+        return None
+    head = d.split(".", 1)[0]
+    resolved = d.replace(head, imports.get(head, head), 1)
+    if resolved == f"jax.lax.{name}" or resolved.endswith(f".lax.{name}"):
+        return name
+    return None
+
+
+def _module_constants(mod: ParsedModule) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = node.value.value
+    return out
+
+
+def _local_declared_axes(mod: ParsedModule) -> set:
+    """Axes declared inside this module: pmap(axis_name=...), Mesh/make_mesh
+    axis_names=(...) with literal names."""
+    axes = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node)
+        if name == "pmap":
+            v = get_kwarg(node, "axis_name")
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                axes.add(v.value)
+        elif name in ("Mesh", "make_mesh"):
+            v = get_kwarg(node, "axis_names")
+            if v is None and name == "Mesh" and len(node.args) > 1:
+                v = node.args[1]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        axes.add(e.value)
+    return axes
+
+
+class _AxisResolver:
+    def __init__(self, mod: ParsedModule, ctx: LintContext):
+        self.consts = _module_constants(mod)
+        self.ctx = ctx
+
+    def resolve(self, node: ast.expr, fn_stack) -> Optional[Tuple[Tuple[str, bool], ...]]:
+        """-> tuple of (axis string, was_literal_here) or None if opaque.
+
+        ``was_literal_here`` is True only for a string literal written
+        directly at the call site (not one reached through a constant or a
+        parameter default).
+        """
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return ((node.value, True),)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                r = self.resolve(e, fn_stack)
+                if r is None:
+                    return None
+                out.extend(r)
+            return tuple(out)
+        if isinstance(node, ast.Attribute):
+            val = self.ctx.axis_constants.get(node.attr)
+            return ((val, False),) if val is not None else None
+        if isinstance(node, ast.Name):
+            if node.id in self.consts:
+                return ((self.consts[node.id], False),)
+            if node.id in self.ctx.axis_constants:
+                return ((self.ctx.axis_constants[node.id], False),)
+            # a parameter of an enclosing def: trust its default value
+            for fn in reversed(fn_stack):
+                args = fn.args
+                pos = args.posonlyargs + args.args
+                defaults = args.defaults
+                offset = len(pos) - len(defaults)
+                for i, a in enumerate(pos):
+                    if a.arg == node.id:
+                        if i >= offset:
+                            d = self.resolve(defaults[i - offset], fn_stack[:-1])
+                            if d is not None:
+                                # defaults are declarations, not call-site
+                                # literals — never literal-warn through them
+                                return tuple((ax, False) for ax, _ in d)
+                        return None
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    if a.arg == node.id:
+                        if d is not None:
+                            r = self.resolve(d, fn_stack[:-1])
+                            if r is not None:
+                                return tuple((ax, False) for ax, _ in r)
+                        return None
+        return None
+
+
+def check_collective_axes(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
+    imports = import_map(mod.tree)
+    resolver = _AxisResolver(mod, ctx)
+    declared = ctx.mesh_axes | _local_declared_axes(mod)
+    findings: List[Finding] = []
+
+    # (enclosing fn, op, operand dump) -> (axes frozenset, line of first use)
+    seen: Dict[Tuple[int, str, str], Tuple[frozenset, int]] = {}
+
+    def visit(node: ast.AST, fn_stack):
+        for child in ast.iter_child_nodes(node):
+            child_stack = fn_stack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_stack = fn_stack + [child]
+            if isinstance(child, ast.Call):
+                op = _is_lax_collective(child, imports)
+                if op is not None:
+                    handle(child, op, fn_stack)
+            visit(child, child_stack)
+
+    def handle(call: ast.Call, op: str, fn_stack):
+        pos, kwname = COLLECTIVES[op]
+        axis_node = get_arg(call, pos, kwname)
+        if axis_node is None:
+            return
+        resolved = resolver.resolve(axis_node, fn_stack)
+        if resolved is None:
+            return
+        for axis, literal_here in resolved:
+            if axis not in declared:
+                findings.append(Finding(
+                    "collective-axis", "error", mod.path, call.lineno,
+                    f"lax.{op} uses axis {axis!r}, which no mesh axis "
+                    f"(*_AXIS constant / Mesh axis_names), pmap or "
+                    f"shard_map declares — known axes: "
+                    f"{sorted(declared)}",
+                ))
+            elif literal_here and axis in ctx.axis_constants.values():
+                const = next(
+                    k for k, v in ctx.axis_constants.items() if v == axis
+                )
+                findings.append(Finding(
+                    "collective-axis-literal", "warning", mod.path,
+                    call.lineno,
+                    f"lax.{op} spells axis {axis!r} as a string literal; "
+                    f"use the shared constant {const} so call sites cannot "
+                    f"drift apart",
+                ))
+        # consistency: same op on the same named operand, different axes
+        axes_set = frozenset(ax for ax, _ in resolved)
+        if len(call.args) > 0 and isinstance(call.args[0], ast.Name):
+            key = (
+                id(fn_stack[-1]) if fn_stack else 0,
+                op,
+                call.args[0].id,
+            )
+            prior = seen.get(key)
+            if prior is None:
+                seen[key] = (axes_set, call.lineno)
+            elif prior[0] != axes_set:
+                findings.append(Finding(
+                    "collective-axis-inconsistent", "warning", mod.path,
+                    call.lineno,
+                    f"lax.{op}({call.args[0].id}, ...) uses axes "
+                    f"{sorted(axes_set)} here but {sorted(prior[0])} at "
+                    f"line {prior[1]} — the same logical collective should "
+                    f"name the same axis at every call site",
+                ))
+
+    visit(mod.tree, [])
+    return findings

@@ -1,0 +1,233 @@
+"""Rule: host-transfer — no host syncs reachable from a compiled step body.
+
+``float(x)``, ``np.asarray(x)``, ``x.item()`` and ``jax.device_get`` on a
+device value block until the async dispatch queue drains; inside the train
+step's call tree they serialize every step on a device→host round trip
+(the reference's per-step ``scaler`` sync is exactly the bug class). Under
+``jit`` tracing they fail loudly — but helpers shared between host code
+and step code only get traced on the path that imports them, so the lint
+walks the whole-package static call graph instead:
+
+roots     the compiled step bodies: functions named ``_local_*`` or nested
+          inside a ``make_*`` builder, in modules under ``train/``
+edges     calls resolved through same-module defs, package imports
+          (``from pkg.mod import f``), module aliases (``mod.f``) and
+          imported-class methods (``Cls.method``)
+findings  any reachable function whose body calls float()/np.asarray()/
+          np.array()/.item()/jax.device_get — reported with the call chain
+          from the root so the fix site is obvious
+
+Dynamic dispatch (``state.apply_fn``, method calls on values) is outside
+static reach and intentionally unresolved; the runtime companion
+(``analysis.guards.no_recompile`` with its transfer guard) covers it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from pytorch_distributed_tpu.analysis._astutil import (
+    import_map,
+    terminal_name,
+    walk_functions,
+)
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ParsedModule,
+)
+
+_NUMPY_SYNCS = {"asarray", "array"}
+
+
+def _module_key(path: str) -> str:
+    """Dotted-ish key for matching import origins to scanned files:
+    'pytorch_distributed_tpu/ops/losses.py' -> 'pytorch_distributed_tpu.ops.losses'."""
+    return path[:-3].replace("/", ".") if path.endswith(".py") else path
+
+
+class _Program:
+    """Whole-run view: defs, classes and imports of every scanned module."""
+
+    def __init__(self, ctx: LintContext):
+        self.mods: Dict[str, ParsedModule] = {
+            _module_key(m.path): m for m in ctx.modules
+        }
+        self.defs: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        self.classes: Dict[str, Dict[str, ast.ClassDef]] = {}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        for key, m in self.mods.items():
+            self.defs[key] = {
+                n.name: n for n in m.tree.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            self.classes[key] = {
+                n.name: n for n in m.tree.body if isinstance(n, ast.ClassDef)
+            }
+            self.imports[key] = import_map(m.tree)
+
+    def find_module(self, origin: str) -> Optional[str]:
+        """Scanned-module key for an import origin, tolerating the scan
+        root not being the package root (e.g. fixtures)."""
+        if origin in self.mods:
+            return origin
+        for key in self.mods:
+            if origin.endswith("." + key) or key.endswith("." + origin):
+                return key
+        return None
+
+    def resolve_call(
+        self, call: ast.Call, mod_key: str
+    ) -> Optional[Tuple[str, ast.FunctionDef]]:
+        """(module key, def node) for a package-internal call, else None."""
+        imports = self.imports.get(mod_key, {})
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = self.defs.get(mod_key, {}).get(func.id)
+            if local is not None:
+                return (mod_key, local)
+            origin = imports.get(func.id)
+            if origin and "." in origin:
+                omod, _, oname = origin.rpartition(".")
+                target = self.find_module(omod)
+                if target:
+                    d = self.defs.get(target, {}).get(oname)
+                    if d is not None:
+                        return (target, d)
+                    cls = self.classes.get(target, {}).get(oname)
+                    if cls is not None:
+                        init = next(
+                            (n for n in cls.body
+                             if isinstance(n, ast.FunctionDef)
+                             and n.name == "__init__"),
+                            None,
+                        )
+                        if init is not None:
+                            return (target, init)
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            base, attr = func.value.id, func.attr
+            origin = imports.get(base)
+            if origin is None:
+                # Cls.method on a class defined in this module
+                cls = self.classes.get(mod_key, {}).get(base)
+                if cls is not None:
+                    m = next(
+                        (n for n in cls.body
+                         if isinstance(n, ast.FunctionDef) and n.name == attr),
+                        None,
+                    )
+                    if m is not None:
+                        return (mod_key, m)
+                return None
+            # module alias: mod.f()
+            target = self.find_module(origin)
+            if target:
+                d = self.defs.get(target, {}).get(attr)
+                if d is not None:
+                    return (target, d)
+            # imported class: Cls.method()
+            if "." in origin:
+                omod, _, oname = origin.rpartition(".")
+                target = self.find_module(omod)
+                if target:
+                    cls = self.classes.get(target, {}).get(oname)
+                    if cls is not None:
+                        m = next(
+                            (n for n in cls.body
+                             if isinstance(n, ast.FunctionDef)
+                             and n.name == attr),
+                            None,
+                        )
+                        if m is not None:
+                            return (target, m)
+        return None
+
+
+def _hot_roots(mod: ParsedModule) -> List[Tuple[ast.FunctionDef, str]]:
+    """Compiled step bodies in this module: (def, qualname)."""
+    if "train/" not in mod.path and not os.path.basename(mod.path).startswith(
+        "step"
+    ):
+        return []
+    roots = []
+    for fn, stack in walk_functions(mod.tree):
+        enclosing = stack[-1].name if stack else ""
+        if fn.name.startswith("_local_") or (
+            stack and enclosing.startswith("make_")
+        ):
+            qual = ".".join([s.name for s in stack] + [fn.name])
+            roots.append((fn, qual))
+    return roots
+
+
+def _violations_in(fn: ast.FunctionDef, imports: Dict[str, str]):
+    """(line, description) for every host-sync call in the def's subtree."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "float" and node.args:
+            out.append((node.lineno, "float(...) forces a device→host sync"))
+        elif isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args and not node.keywords:
+                out.append((node.lineno, ".item() forces a device→host sync"))
+            elif f.attr == "device_get":
+                out.append((node.lineno, "jax.device_get pulls the value to host"))
+            elif f.attr in _NUMPY_SYNCS and isinstance(f.value, ast.Name):
+                origin = imports.get(f.value.id, "")
+                if origin == "numpy" or origin.startswith("numpy."):
+                    out.append((
+                        node.lineno,
+                        f"np.{f.attr}(...) materializes the array on host",
+                    ))
+        elif isinstance(f, ast.Name) and f.id == "device_get":
+            origin = imports.get("device_get", "")
+            if origin.startswith("jax"):
+                out.append((node.lineno, "jax.device_get pulls the value to host"))
+    return out
+
+
+def check_host_transfers(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
+    roots = _hot_roots(mod)
+    if not roots:
+        return []
+    prog = _Program(ctx)
+    mod_key = _module_key(mod.path)
+    findings: List[Finding] = []
+    # BFS over (module, def), remembering the call chain from the root
+    for root, qual in roots:
+        seen: Set[Tuple[str, int]] = set()
+        queue: List[Tuple[str, ast.FunctionDef, Tuple[str, ...]]] = [
+            (mod_key, root, (qual,))
+        ]
+        while queue:
+            key, fn, chain = queue.pop()
+            if (key, id(fn)) in seen:
+                continue
+            seen.add((key, id(fn)))
+            target_mod = prog.mods[key]
+            imports = prog.imports[key]
+            for line, desc in _violations_in(fn, imports):
+                if target_mod.is_suppressed("host-transfer", line):
+                    continue
+                via = " -> ".join(chain)
+                findings.append(Finding(
+                    "host-transfer", "error", target_mod.path, line,
+                    f"{desc}, inside the compiled step's call tree "
+                    f"({via} -> {fn.name})",
+                ))
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    resolved = prog.resolve_call(node, key)
+                    if resolved is not None:
+                        tkey, tfn = resolved
+                        queue.append((tkey, tfn, chain + (fn.name,)))
+    # dedupe (several roots can reach the same sync site)
+    unique = {}
+    for f in findings:
+        unique.setdefault((f.path, f.line, f.message.split(" (")[0]), f)
+    return list(unique.values())

@@ -1,0 +1,82 @@
+"""Rule: precision-cast — dtype policy lives in ops/precision.py, not inline.
+
+The mixed-precision contract (params f32, compute bf16, outputs f32) is
+owned by ``ops.precision.Policy``; an inline ``.astype(jnp.bfloat16)``
+inside an op silently overrides the policy for every caller — including
+the fp32 baseline recipes that exist to measure bf16 against. This rule
+flags literal float-dtype casts in modules under ``ops/`` (the policy's
+jurisdiction), except in ``ops/precision.py`` itself.
+
+Intentional sites — fp32 accumulators inside flash/ring kernels, loss
+upcasts required for numerics — stay, with either an inline
+``# jaxlint: disable=precision-cast -- <why>`` or an entry in the lint
+baseline (``scripts/jaxlint_baseline.json``); either way the reason is
+recorded next to the cast instead of living in someone's head.
+
+Flagged forms: ``x.astype(jnp.float32)``, ``x.astype(np.bfloat16)``,
+``x.astype("float32")`` and ``jnp.asarray(x, jnp.bfloat16)`` /
+``jnp.array(x, dtype="float32")``. Policy-driven casts
+(``x.astype(self.compute_dtype)``, ``x.astype(q.dtype)``) are the point
+of the rule and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from pytorch_distributed_tpu.analysis._astutil import dotted, get_kwarg
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ParsedModule,
+)
+
+_POLICY_DTYPES = {"float32", "bfloat16", "float16"}
+_SCOPE_DIR = "ops/"
+_EXEMPT_BASENAME = "precision.py"
+
+
+def _literal_dtype(node: ast.expr) -> Optional[str]:
+    """'float32' for jnp.float32 / np.bfloat16 / "float32" literals."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _POLICY_DTYPES else None
+    d = dotted(node)
+    if d is None:
+        return None
+    tail = d.rsplit(".", 1)[-1]
+    return tail if tail in _POLICY_DTYPES else None
+
+
+def check_precision_casts(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
+    path = mod.path
+    if _SCOPE_DIR not in path or path.endswith("/" + _EXEMPT_BASENAME):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        dt = None
+        form = None
+        if isinstance(f, ast.Attribute) and f.attr == "astype" and node.args:
+            dt = _literal_dtype(node.args[0])
+            form = "astype"
+        elif isinstance(f, ast.Attribute) and f.attr in ("asarray", "array"):
+            arg = get_kwarg(node, "dtype")
+            if arg is None and len(node.args) > 1:
+                arg = node.args[1]
+            if arg is not None:
+                dt = _literal_dtype(arg)
+                form = f.attr
+        if dt is None:
+            continue
+        direction = "upcast to" if dt == "float32" else "downcast to"
+        findings.append(Finding(
+            "precision-cast", "warning", path, node.lineno,
+            f"literal {direction} {dt} via .{form}() outside "
+            f"ops/precision.py's Policy helpers — route dtype decisions "
+            f"through the policy (or record why not: "
+            f"'# jaxlint: disable=precision-cast -- <reason>')",
+        ))
+    return findings

@@ -1,0 +1,353 @@
+"""Rule: recompile hazards around jit/pjit.
+
+Silent recompilation is the stealth perf killer of the pjit stack: the
+step "works" but retraces every call, so a 50ms step becomes a 30s one and
+nobody gets an error. Four statically-checkable shapes of the bug:
+
+- ``recompile-traced-branch`` (error): a Python ``if``/``while`` on an
+  argument of a jit-compiled function. Arguments are tracers; branching on
+  one either raises TracerBoolConversionError or — when the value is
+  concrete because the arg was marked static — recompiles per value.
+- ``recompile-jit-call`` (warning): ``jax.jit(f)(x)`` invoked in one
+  expression inside a function body. The returned compiled function is
+  dropped on the floor, so every call pays a fresh trace+compile.
+- ``recompile-mutable-closure`` (warning): a jit-compiled function reads a
+  module-level list/dict/set that the module mutates elsewhere. jit
+  captures closures at trace time; later mutations are silently ignored
+  (stale constants) or, for hashable wrappers, retrigger tracing.
+- ``recompile-static-argnums`` (error): ``static_argnums`` indices out of
+  range of the target's signature, overlapping ``donate_argnums``, or
+  marking a parameter whose default is a non-hashable list/dict/set —
+  every call with such a value raises or recompiles.
+
+jit targets are found through direct decorators (``@jax.jit``,
+``@partial(jax.jit, ...)``) and through call chains in the same scope
+(``jax.jit(shard_map(_local_step, ...))`` and the two-statement spelling
+``sharded = shard_map(_local_step, ...); jax.jit(sharded)``) — the idiom
+every step builder in ``train/`` uses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from pytorch_distributed_tpu.analysis._astutil import (
+    assigned_name_targets,
+    get_kwarg,
+    import_map,
+    int_constants,
+    param_names,
+    terminal_name,
+    walk_functions,
+)
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ParsedModule,
+)
+
+_JIT_NAMES = ("jit", "pjit")
+_WRAPPER_NAMES = ("shard_map", "partial", "wraps", "pmap")
+_STATIC_TEST_CALLS = {
+    "isinstance", "callable", "hasattr", "getattr", "len", "issubclass",
+}
+
+
+def _is_jit_call(call: ast.Call, imports: Dict[str, str]) -> bool:
+    name = terminal_name(call)
+    if name not in _JIT_NAMES:
+        return False
+    # accept jax.jit / pjit.pjit / bare jit imported from jax
+    d = call.func
+    if isinstance(d, ast.Name):
+        origin = imports.get(d.id, "")
+        return origin in ("jax.jit", "jax.experimental.pjit.pjit", "jit",
+                          "pjit") or origin.endswith(f".{name}")
+    return True  # attribute form like jax.jit / pjit.pjit
+
+
+def _jit_target_defs(
+    mod: ParsedModule, imports: Dict[str, str]
+) -> Dict[int, Tuple[ast.FunctionDef, ast.Call]]:
+    """id(def node) -> (def node, jit call) for every local def that ends
+    up jitted.
+
+    Resolution follows Name arguments through assignments and wrapper
+    calls (shard_map/partial) with real lexical scoping — innermost scope
+    first — so two nested helpers sharing a name never cross-resolve.
+    """
+    out: Dict[int, Tuple[ast.FunctionDef, ast.Call]] = {}
+
+    def scope_tables(body) -> Tuple[Dict[str, ast.FunctionDef], Dict[str, ast.expr]]:
+        defs: Dict[str, ast.FunctionDef] = {}
+        assigns: Dict[str, ast.expr] = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, ast.Name):
+                    assigns[t.id] = stmt.value
+            # scan one level into compound statements (if/try/with/for):
+            # assignments there are visible in the same scope
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, sub.value)
+        return defs, assigns
+
+    def chase(expr, scopes, depth: int = 0) -> Optional[ast.FunctionDef]:
+        if depth > 6 or expr is None:
+            return None
+        if isinstance(expr, ast.Name):
+            for defs, assigns in reversed(scopes):
+                if expr.id in defs:
+                    return defs[expr.id]
+                if expr.id in assigns:
+                    return chase(assigns[expr.id], scopes, depth + 1)
+            return None
+        if isinstance(expr, ast.Call):
+            name = terminal_name(expr)
+            if name in _WRAPPER_NAMES:
+                if expr.args:
+                    return chase(expr.args[0], scopes, depth + 1)
+                f = get_kwarg(expr, "f") or get_kwarg(expr, "fun")
+                if f is not None:
+                    return chase(f, scopes, depth + 1)
+        return None
+
+    def visit(body, scopes):
+        tables = scope_tables(body)
+        scopes = scopes + [tables]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # visited via their own scope below
+                if (
+                    isinstance(node, ast.Call)
+                    and _is_jit_call(node, imports)
+                    and node.args
+                ):
+                    target = chase(node.args[0], scopes)
+                    if target is not None:
+                        out[id(target)] = (target, node)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(stmt.body, scopes)
+
+    # NB: ast.walk above still descends into nested defs from the outer
+    # statement — acceptable: a jit call inside a nested def sees the
+    # outer scopes, and name shadowing resolves innermost-first when the
+    # nested def is visited with its own scope pushed.
+    visit(mod.tree.body, [])
+
+    # decorator form
+    for fn, _stack in walk_functions(mod.tree):
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and _is_jit_call(dec, imports):
+                out[id(fn)] = (fn, dec)
+            elif isinstance(dec, ast.Call) and terminal_name(dec) == "partial":
+                if dec.args and isinstance(dec.args[0], (ast.Attribute, ast.Name)):
+                    inner = ast.Call(func=dec.args[0], args=[], keywords=dec.keywords)
+                    ast.copy_location(inner, dec)
+                    if _is_jit_call(inner, imports):
+                        out[id(fn)] = (fn, dec)
+            elif isinstance(dec, (ast.Attribute, ast.Name)):
+                probe = ast.Call(func=dec, args=[], keywords=[])
+                ast.copy_location(probe, dec)
+                if _is_jit_call(probe, imports):
+                    out[id(fn)] = (fn, probe)
+    return out
+
+
+def _static_param_names(fn: ast.FunctionDef, jit_call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    params = param_names(fn)
+    nums = get_kwarg(jit_call, "static_argnums")
+    if nums is not None:
+        for i in int_constants(nums) or []:
+            if 0 <= i < len(params):
+                names.add(params[i])
+    argnames = get_kwarg(jit_call, "static_argnames")
+    if argnames is not None:
+        if isinstance(argnames, ast.Constant) and isinstance(argnames.value, str):
+            names.add(argnames.value)
+        elif isinstance(argnames, (ast.Tuple, ast.List)):
+            names.update(
+                e.value for e in argnames.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return names
+
+
+def _names_in_test(test: ast.expr) -> Set[str]:
+    """Bare Names the branch condition genuinely depends on as VALUES.
+
+    Excludes attribute/subscript bases (``state.batch_stats`` truthiness is
+    a static container check), ``is``/``is not`` comparisons, and arguments
+    of shape/type predicates (isinstance, len, ...).
+    """
+    out: Set[str] = set()
+
+    def visit(node: ast.expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.BoolOp):
+            for v in node.values:
+                visit(v)
+        elif isinstance(node, ast.UnaryOp):
+            visit(node.operand)
+        elif isinstance(node, ast.Compare):
+            ops_ok = all(
+                not isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            )
+            if ops_ok:
+                visit(node.left)
+                for c in node.comparators:
+                    visit(c)
+        elif isinstance(node, ast.BinOp):
+            visit(node.left)
+            visit(node.right)
+        elif isinstance(node, ast.Call):
+            if terminal_name(node) not in _STATIC_TEST_CALLS:
+                for a in node.args:
+                    visit(a)
+        # Attribute/Subscript: deliberately not descended
+
+    visit(test)
+    return out
+
+
+def _module_mutable_globals(mod: ParsedModule) -> Set[str]:
+    """Module-level names bound to mutable literals AND mutated somewhere."""
+    mutable: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp)
+        ):
+            mutable.update(assigned_name_targets(node))
+    if not mutable:
+        return set()
+    mutated: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if isinstance(base, ast.Name) and base.id in mutable:
+                if node.func.attr in (
+                    "append", "extend", "insert", "pop", "update", "clear",
+                    "setdefault", "add", "remove", "discard",
+                ):
+                    mutated.add(base.id)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                    if t.value.id in mutable:
+                        mutated.add(t.value.id)
+        elif isinstance(node, ast.Global):
+            mutated.update(n for n in node.names if n in mutable)
+    return mutable & mutated
+
+
+def check_recompile_hazards(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
+    imports = import_map(mod.tree)
+    findings: List[Finding] = []
+    jitted = _jit_target_defs(mod, imports)
+    mutable_globals = _module_mutable_globals(mod)
+
+    # --- per jitted def: traced branches, mutable closures, static args ---
+    for fn, jit_call in jitted.values():
+        name = fn.name
+        params = set(param_names(fn))
+        static = _static_param_names(fn, jit_call)
+        traced = params - static
+        local_binds: Set[str] = set()
+        for node in ast.walk(fn):
+            local_binds.update(assigned_name_targets(node))
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                hits = _names_in_test(node.test) & traced
+                if hits:
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    findings.append(Finding(
+                        "recompile-traced-branch", "error", mod.path,
+                        node.lineno,
+                        f"Python {kind} on traced argument(s) "
+                        f"{sorted(hits)} of jit-compiled {name!r}: tracers "
+                        f"cannot drive Python control flow (use lax.cond/"
+                        f"jnp.where, or mark the argument static and accept "
+                        f"one compile per value)",
+                    ))
+            elif isinstance(node, ast.Name) and node.id in mutable_globals:
+                if node.id not in local_binds:
+                    findings.append(Finding(
+                        "recompile-mutable-closure", "warning", mod.path,
+                        node.lineno,
+                        f"jit-compiled {name!r} reads module-level mutable "
+                        f"{node.id!r}, which this module mutates elsewhere; "
+                        f"jit captures it at trace time, so later mutations "
+                        f"are silently ignored — pass it as an argument",
+                    ))
+
+        # static_argnums sanity
+        nums_node = get_kwarg(jit_call, "static_argnums")
+        nums = int_constants(nums_node) if nums_node is not None else None
+        donate_node = get_kwarg(jit_call, "donate_argnums")
+        donate = int_constants(donate_node) if donate_node is not None else None
+        n_params = len(param_names(fn))
+        if nums:
+            for i in nums:
+                if i >= n_params or i < -n_params:
+                    findings.append(Finding(
+                        "recompile-static-argnums", "error", mod.path,
+                        jit_call.lineno,
+                        f"static_argnums={i} is out of range for {name!r} "
+                        f"({n_params} parameter(s))",
+                    ))
+            if donate and set(nums) & set(donate):
+                findings.append(Finding(
+                    "recompile-static-argnums", "error", mod.path,
+                    jit_call.lineno,
+                    f"static_argnums and donate_argnums overlap on "
+                    f"{sorted(set(nums) & set(donate))} for {name!r}: a "
+                    f"static argument is part of the cache key and cannot "
+                    f"be donated",
+                ))
+            # non-hashable default on a static parameter
+            args = fn.args
+            pos = args.posonlyargs + args.args
+            offset = len(pos) - len(args.defaults)
+            for i in nums:
+                if 0 <= i < len(pos) and i >= offset:
+                    default = args.defaults[i - offset]
+                    if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                        findings.append(Finding(
+                            "recompile-static-argnums", "error", mod.path,
+                            jit_call.lineno,
+                            f"static argument {pos[i].arg!r} of {name!r} "
+                            f"defaults to a non-hashable "
+                            f"{type(default).__name__.lower()}; static "
+                            f"arguments are dict keys of the jit cache and "
+                            f"must be hashable",
+                        ))
+
+    # --- jit-created-and-called-immediately inside a def ---
+    for fn, _stack in walk_functions(mod.tree):
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Call)
+                and _is_jit_call(node.func, imports)
+            ):
+                findings.append(Finding(
+                    "recompile-jit-call", "warning", mod.path, node.lineno,
+                    "jax.jit(...) built and invoked in one expression "
+                    "inside a function: the compiled callable (and its "
+                    "cache) is discarded after the call — hoist the jit "
+                    "out of the per-call path",
+                ))
+    return findings

@@ -103,7 +103,7 @@ def attend_block(
         p = p * allowed[None, None]
     l_block = jnp.transpose(jnp.sum(p, axis=-1), (0, 2, 1))
     o_block = jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),  # jaxlint: disable=precision-cast -- fp32 PV accumulation; o/l state is fp32 by kernel contract
         preferred_element_type=jnp.float32,
     )
     return SoftmaxState(
@@ -139,7 +139,7 @@ def dense_attention(
         # Fully-masked rows: zeros, not uniform (matches blockwise/ring).
         probs = probs * probs_mask
     return jnp.einsum(
-        "bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)
+        "bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)  # jaxlint: disable=precision-cast -- fp32 PV matmul matches blockwise/ring accumulator dtype
     ).astype(q.dtype)
 
 

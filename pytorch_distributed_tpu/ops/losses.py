@@ -27,7 +27,7 @@ def cross_entropy_loss(
       label_smoothing: optional epsilon-smoothing (0.0 matches the reference).
       reduction: 'mean' | 'sum' | 'none'.
     """
-    logits = logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)  # jaxlint: disable=precision-cast -- CE softmax always fp32 (the Policy.output_dtype contract)
     num_classes = logits.shape[-1]
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     if label_smoothing > 0.0:

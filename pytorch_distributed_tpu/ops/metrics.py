@@ -27,7 +27,7 @@ def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)) -> dict:
     _, pred = jax.lax.top_k(logits, max_k)  # [batch, max_k]
     hit = pred == labels[:, None].astype(pred.dtype)  # [batch, max_k]
     return {
-        f"correct{k}": jnp.sum(hit[:, : min(k, num_classes)]).astype(jnp.float32)
+        f"correct{k}": jnp.sum(hit[:, : min(k, num_classes)]).astype(jnp.float32)  # jaxlint: disable=precision-cast -- psum'd counters must be fp32: exact integer sums
         for k in ks
     }
 
@@ -60,10 +60,10 @@ class ClassificationMetrics:
     ) -> "ClassificationMetrics":
         correct = topk_correct(logits, labels, ks=(1, 5))
         return cls(
-            loss_sum=loss_sum.astype(jnp.float32),
+            loss_sum=loss_sum.astype(jnp.float32),  # jaxlint: disable=precision-cast -- psum'd counters must be fp32: exact integer sums
             correct1=correct["correct1"],
             correct5=correct["correct5"],
-            count=jnp.asarray(logits.shape[0], jnp.float32),
+            count=jnp.asarray(logits.shape[0], jnp.float32),  # jaxlint: disable=precision-cast -- psum'd counters must be fp32: exact integer sums
         )
 
     def merge(self, other: "ClassificationMetrics") -> "ClassificationMetrics":

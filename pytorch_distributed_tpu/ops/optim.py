@@ -65,7 +65,7 @@ def sharded_global_norm(tree, specs=None) -> jnp.ndarray:
 
     def add(g, spec):
         ax = spec_axes(spec)
-        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))  # jaxlint: disable=precision-cast -- global-norm square-sums accumulate in fp32 for every policy
         buckets[ax] = buckets.get(ax, jnp.float32(0.0)) + sq
 
     if specs is None:

@@ -22,7 +22,7 @@ def step_lr(
     """lr = base * gamma ** (epoch // step_size_epochs), epoch derived from step."""
 
     def schedule(step):
-        epoch = jnp.asarray(step, jnp.float32) // float(max(steps_per_epoch, 1))
+        epoch = jnp.asarray(step, jnp.float32) // float(max(steps_per_epoch, 1))  # jaxlint: disable=precision-cast -- LR math on the step counter is fp32 scalar arithmetic
         exponent = jnp.floor(epoch / float(step_size_epochs))
         return base_lr * jnp.power(gamma, exponent)
 
@@ -40,7 +40,7 @@ def warmup_cosine(
     than bs-400-per-replica SGD+StepLR was tuned for."""
 
     def schedule(step):
-        step = jnp.asarray(step, jnp.float32)
+        step = jnp.asarray(step, jnp.float32)  # jaxlint: disable=precision-cast -- LR math on the step counter is fp32 scalar arithmetic
         warm = base_lr * step / jnp.maximum(float(warmup_steps), 1.0)
         progress = (step - warmup_steps) / jnp.maximum(
             float(total_steps - warmup_steps), 1.0

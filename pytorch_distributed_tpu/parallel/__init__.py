@@ -5,6 +5,7 @@ from pytorch_distributed_tpu.parallel.fsdp import (
 )
 from pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
+    MESH_AXES,
     MODEL_AXIS,
     SEQ_AXIS,
     batch_sharding,
@@ -40,6 +41,7 @@ __all__ = [
     "fsdp_state_specs",
     "shard_fsdp_state",
     "DATA_AXIS",
+    "MESH_AXES",
     "MODEL_AXIS",
     "SEQ_AXIS",
     "make_mesh",

@@ -43,13 +43,18 @@ DATA_AXIS = "data"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
+# The canonical axis order, in one place: jaxlint's collective-axis rule
+# treats these constants as the declared axis set, so a collective naming
+# anything else is a build error (ANALYSIS.md).
+MESH_AXES = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+
 
 def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     data_parallel: Optional[int] = None,
     model_parallel: int = 1,
     seq_parallel: int = 1,
-    axis_names: Sequence[str] = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS),
+    axis_names: Sequence[str] = MESH_AXES,
 ) -> Mesh:
     """Build a (data, seq, model) mesh over the given (default: all) devices.
 

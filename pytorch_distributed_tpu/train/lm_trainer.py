@@ -212,16 +212,16 @@ class LMTrainer(SuspendableTrainer):
                         f"!= tp_size {model_config.tp_size}"
                     )
                 if (model_config.model_axis is None
-                        and self.mesh.shape.get("model", 1) > 1):
+                        and self.mesh.shape.get(mesh_lib.MODEL_AXIS, 1) > 1):
                     raise ValueError(
                         "the mesh carries a model axis of size "
-                        f"{self.mesh.shape['model']} but the model config "
+                        f"{self.mesh.shape[mesh_lib.MODEL_AXIS]} but the model config "
                         "has no model_axis — every chip on it would do "
                         "duplicate work; set model_axis/tp_size or size "
                         "the axis to 1"
                     )
             else:
-                stage_axis = "model"
+                stage_axis = mesh_lib.MODEL_AXIS
                 if model_config.model_axis is not None:
                     raise ValueError(
                         "TP-within-PP needs a dedicated stage axis — "
@@ -237,7 +237,7 @@ class LMTrainer(SuspendableTrainer):
                     f"(got {self.mesh.shape.get(stage_axis)}); build the "
                     "mesh with that axis sized to pipeline_stages"
                 )
-            if self.mesh.shape.get("seq", 1) > 1:
+            if self.mesh.shape.get(mesh_lib.SEQ_AXIS, 1) > 1:
                 raise ValueError(
                     "the PP trainer shards batches over data only; use "
                     "seq_parallel=1 (ring attention cannot run inside a "

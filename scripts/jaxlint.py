@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""jaxlint CLI — static SPMD/jit correctness lint over a source tree.
+
+    python scripts/jaxlint.py pytorch_distributed_tpu/
+    python scripts/jaxlint.py --list-rules
+    python scripts/jaxlint.py --no-baseline tests/fixtures/jaxlint/
+
+Exit codes: 0 no new findings; 1 new findings; 2 usage/internal error.
+
+Pre-existing, reviewed findings live in scripts/jaxlint_baseline.json
+(each with a reason) and don't fail the run; anything NOT in the baseline
+does. The partition-coverage check needs an importable jax and is skipped
+with a notice when that fails (e.g. a docs-only CI container).
+
+Rules, severities and the suppression syntax are documented in ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pytorch_distributed_tpu.analysis import (  # noqa: E402
+    all_rule_ids,
+    load_baseline,
+    run_lint,
+    split_baselined,
+)
+
+DEFAULT_BASELINE = os.path.join(REPO, "scripts", "jaxlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of reviewed findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baseline ignored")
+    ap.add_argument("--no-partition-coverage", action="store_true",
+                    help="skip the runtime partition-rule coverage check")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, severity, desc in all_rule_ids():
+            print(f"{rule:32} {severity:8} {desc}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("jaxlint: error: no paths given", file=sys.stderr)
+        return 2
+
+    findings = run_lint(args.paths, rel_root=REPO)
+
+    lint_package = any(
+        os.path.abspath(p).startswith(
+            os.path.join(REPO, "pytorch_distributed_tpu")
+        )
+        for p in args.paths
+    )
+    if lint_package and not args.no_partition_coverage:
+        try:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            from pytorch_distributed_tpu.analysis.partition_coverage import (
+                check_partition_coverage,
+            )
+
+            findings = list(findings) + check_partition_coverage()
+        except ImportError as e:
+            print(f"jaxlint: partition-coverage skipped (no jax: {e})",
+                  file=sys.stderr)
+
+    entries = []
+    if not args.no_baseline and os.path.exists(args.baseline):
+        entries = load_baseline(args.baseline)
+    sources = {}
+    for p in {f.path for f in findings}:
+        ap_path = os.path.join(REPO, p)
+        if os.path.exists(ap_path):
+            with open(ap_path, "r", encoding="utf-8") as fh:
+                sources[p] = fh.read().splitlines()
+    new, baselined = split_baselined(findings, entries, sources)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "baselined": [vars(f) for f in baselined],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        n_err = sum(1 for f in new if f.severity == "error")
+        n_warn = len(new) - n_err
+        print(
+            f"jaxlint: {n_err} error(s), {n_warn} warning(s), "
+            f"{len(baselined)} baselined finding(s)"
+            + ("" if args.no_baseline or not os.path.exists(args.baseline)
+               else f" [{os.path.relpath(args.baseline, REPO)}]")
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

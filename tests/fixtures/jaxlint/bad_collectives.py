@@ -1,0 +1,39 @@
+"""Seeded collective-axis violations. Parsed by tests, never imported.
+
+Lines carrying a violation end with ``# EXPECT: <rule>``; the fixture
+test asserts each rule fires exactly there and nowhere else.
+"""
+
+import jax
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def wrong_axis(grads):
+    return jax.lax.psum(grads, "dta")  # EXPECT: collective-axis
+
+
+def wrong_axis_via_constant(grads):
+    return jax.lax.pmean(grads, BOGUS_NAME)  # EXPECT: collective-axis
+
+
+BOGUS_NAME = "batch_dim"
+
+
+def wrong_axis_in_tuple(grads):
+    return jax.lax.psum(grads, (DATA_AXIS, "modle"))  # EXPECT: collective-axis
+
+
+def literal_spelling(grads):
+    # 'data' has a shared constant; spelling it inline drifts call sites
+    return jax.lax.psum(grads, "data")  # EXPECT: collective-axis-literal
+
+
+def inconsistent(grads):
+    grads = jax.lax.pmean(grads, DATA_AXIS)
+    return jax.lax.pmean(grads, SEQ_AXIS)  # EXPECT: collective-axis-inconsistent
+
+
+def wrong_axis_index():
+    return jax.lax.axis_index("sequence")  # EXPECT: collective-axis

@@ -1,0 +1,56 @@
+"""Seeded recompile-hazard violations. Parsed by tests, never imported."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_SCALE_TABLE = {"warm": 1.0}
+
+
+@jax.jit
+def branch_on_traced(x, threshold):
+    if threshold > 0:  # EXPECT: recompile-traced-branch
+        x = x * 2
+    while x:  # EXPECT: recompile-traced-branch
+        x = x - 1
+    return x
+
+
+@jax.jit
+def reads_mutated_global(x):
+    return x * _SCALE_TABLE["warm"]  # EXPECT: recompile-mutable-closure
+
+
+def set_scale(v):
+    _SCALE_TABLE["warm"] = v
+
+
+def per_call_compile(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v + 1)(x))  # EXPECT: recompile-jit-call
+    return out
+
+
+def bad_static(fn_input):
+    def inner(a, b, opts=[1, 2]):
+        return a + b + len(opts)
+
+    return jax.jit(inner, static_argnums=(5,))  # EXPECT: recompile-static-argnums
+
+
+def static_donate_overlap():
+    def inner(state, batch):
+        return state
+
+    return jax.jit(  # EXPECT: recompile-static-argnums
+        inner, static_argnums=(0,), donate_argnums=(0,)
+    )
+
+
+def static_unhashable_default():
+    def inner(x, opts=[1, 2]):
+        return x * len(opts)
+
+    return jax.jit(inner, static_argnums=(1,))  # EXPECT: recompile-static-argnums

@@ -1,0 +1,58 @@
+"""Clean fixture: every pattern the rules police, done right.
+
+The fixture test asserts jaxlint reports ZERO findings here — guarding
+against false positives as the rules evolve. Never imported, only parsed.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def psum_tree(tree, axis=DATA_AXIS):
+    # axis via shared constant, resolvable through the parameter default
+    return jax.lax.psum(tree, axis_name=axis)
+
+
+def combined(tree):
+    # tuple of constants is fine
+    return jax.lax.pmean(tree, (DATA_AXIS, SEQ_AXIS))
+
+
+def consistent(grads, metrics):
+    # same operand, same axis at both sites
+    grads = jax.lax.pmean(grads, DATA_AXIS)
+    grads = jax.lax.pmean(grads, DATA_AXIS)
+    metrics = jax.lax.psum(metrics, (DATA_AXIS, SEQ_AXIS))
+    return grads, metrics
+
+
+def make_step(label_smoothing=0.0):
+    # the builder idiom: closures may drive Python control flow freely
+    def _local_step(state, batch):
+        if label_smoothing:  # closure, not a traced argument
+            pass
+        loss = jnp.mean(batch)
+        return jax.lax.pmean(loss, DATA_AXIS), state
+
+    return jax.jit(_local_step, donate_argnums=(0,))
+
+
+@partial(jax.jit, static_argnums=(1,))
+def scaled(x, factor=2):
+    # static argument legitimately branches: it is a Python value
+    if factor > 1:
+        return x * factor
+    return x
+
+
+_COMPILED = jax.jit(lambda x: x + 1)
+
+
+def hot_loop(xs):
+    # jit built once at module scope, reused per call: no rebuild cost
+    return [_COMPILED(x) for x in xs]

@@ -1,0 +1,19 @@
+"""Helper reachable from the bad_step fixture's compiled step body."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_norm(tree):
+    # host sync buried one call away from the step body
+    total = jnp.zeros(())
+    for leaf in tree.values():
+        total = total + jnp.sum(leaf * leaf)
+    return np.asarray(total)  # EXPECT: host-transfer
+
+
+def honest_norm(tree):
+    total = jnp.zeros(())
+    for leaf in tree.values():
+        total = total + jnp.sum(leaf * leaf)
+    return jnp.sqrt(total)

@@ -1,0 +1,31 @@
+"""Seeded precision-cast violations (module lives under an ops/ dir).
+
+Parsed by tests, never imported.
+"""
+
+import jax.numpy as jnp
+
+
+def sloppy_upcast(x):
+    return x.astype(jnp.float32)  # EXPECT: precision-cast
+
+
+def sloppy_downcast(x):
+    return x.astype(jnp.bfloat16)  # EXPECT: precision-cast
+
+
+def sloppy_string_cast(x):
+    return x.astype("float32")  # EXPECT: precision-cast
+
+
+def sloppy_asarray(x):
+    return jnp.asarray(x, jnp.bfloat16)  # EXPECT: precision-cast
+
+
+def policy_driven(x, policy):
+    # the blessed pattern: dtype flows from the policy object
+    return x.astype(policy.compute_dtype)
+
+
+def peer_driven(x, ref):
+    return x.astype(ref.dtype)

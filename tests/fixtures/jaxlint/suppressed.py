@@ -1,0 +1,23 @@
+"""Every violation here carries a suppression comment; the fixture test
+asserts jaxlint reports ZERO findings — proving the suppression syntax
+works for each rule. Parsed by tests, never imported."""
+
+import jax
+
+DATA_AXIS = "data"
+
+
+def reviewed_axis(grads):
+    # e.g. linting a tree that talks to an external mesh
+    return jax.lax.psum(grads, "replica")  # jaxlint: disable=collective-axis -- external mesh declares this axis
+
+
+def reviewed_literal(grads):
+    return jax.lax.psum(grads, "data")  # jaxlint: disable=collective-axis-literal -- doc example keeps the literal
+
+
+@jax.jit
+def reviewed_branch(x, n):
+    if n > 0:  # jaxlint: disable=recompile-traced-branch -- n is static at every call site; one compile per n is intended
+        return x * n
+    return x

@@ -1,0 +1,33 @@
+"""Seeded host-transfer violations in a step-builder module.
+
+Lives under a ``train/`` directory so the rule treats ``_local_step`` /
+``make_*`` inner defs as hot roots; the cross-module leak goes through
+``hot_helpers`` to prove call-graph reachability, not just direct scans.
+Parsed by tests, never imported.
+"""
+
+import jax
+import numpy as np
+
+from hot_helpers import leaky_norm
+
+DATA_AXIS = "data"
+
+
+def make_train_step(mesh):
+    def _local_step(state, batch):
+        loss = batch["x"].sum()
+        host_loss = float(loss)  # EXPECT: host-transfer
+        arr = np.asarray(loss)  # EXPECT: host-transfer
+        scalar = loss.item()  # EXPECT: host-transfer
+        pulled = jax.device_get(loss)  # EXPECT: host-transfer
+        norm = leaky_norm(state)
+        del host_loss, arr, scalar, pulled
+        return jax.lax.psum(loss, DATA_AXIS), norm
+
+    return jax.jit(_local_step)
+
+
+def host_side_summary(metrics):
+    # NOT reachable from a hot root: float() here is fine
+    return {k: float(v) for k, v in metrics.items()}

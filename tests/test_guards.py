@@ -1,0 +1,92 @@
+"""analysis.guards: the runtime companion catches what the AST cannot —
+recompiles and implicit host transfers after warmup — and the LM train
+step runs 5 guarded steps clean (the acceptance demo)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.analysis import GuardViolation, no_recompile
+
+
+def test_rejects_unjitted_function():
+    with pytest.raises(TypeError, match="jit-compiled"):
+        no_recompile(lambda x: x + 1)
+
+
+def test_steady_state_passes_and_counts():
+    step = no_recompile(jax.jit(lambda x: x * 2), warmup_steps=2)
+    x = jnp.ones((4,))
+    for _ in range(5):
+        x = step(x)
+    assert step.stats.calls == 5
+    assert step.stats.cache_size == 1
+    assert step.stats.recompiles_after_warmup == 0
+
+
+def test_recompile_after_warmup_raises():
+    step = no_recompile(jax.jit(lambda x: x * 2), warmup_steps=2)
+    step(jnp.ones((4,)))
+    step(jnp.ones((4,)))
+    with pytest.raises(GuardViolation, match="cache grew"):
+        step(jnp.ones((5,)))  # new shape -> retrace after warmup
+
+
+def test_shape_churn_during_warmup_is_forgiven():
+    # warmup absorbs the first trace AND a second-shape trace (donation /
+    # layout settling); only growth after the window trips
+    step = no_recompile(jax.jit(lambda x: x + 1), warmup_steps=2)
+    step(jnp.ones((4,)))
+    step(jnp.ones((8,)))  # second compile, still warmup
+    step(jnp.ones((8,)))
+    assert step.stats.cache_size == 2
+
+
+def test_implicit_host_transfer_after_warmup_raises():
+    step = no_recompile(jax.jit(lambda x: x + 1), warmup_steps=1)
+    step(jnp.ones((4,)))
+    step(jnp.ones((4,)))
+    with pytest.raises(GuardViolation, match="host transfer"):
+        step(np.ones((4,), np.float32))  # numpy batch sneaks in H2D
+
+
+def test_lm_train_step_5_guarded_steps(devices8):
+    """Acceptance demo: the real LM train step, wrapped, 5 steps on CPU —
+    no recompiles, no implicit transfers."""
+    from pytorch_distributed_tpu.models.transformer import tiny_config
+    from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
+    from pytorch_distributed_tpu.parallel import make_mesh, replicated_sharding
+    from pytorch_distributed_tpu.train.lm import (
+        create_lm_state,
+        make_lm_train_step,
+        shift_labels,
+    )
+
+    mesh = make_mesh(devices8[:4], data_parallel=4)
+    cfg = tiny_config()
+    state = create_lm_state(
+        cfg, sgd_with_weight_decay(0.1, momentum=0.9, weight_decay=0.0),
+        jax.random.key(0), init_len=8,
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = no_recompile(make_lm_train_step(mesh, config=cfg), warmup_steps=2)
+
+    sharding = NamedSharding(mesh, P("data", "seq"))
+    rng = np.random.default_rng(0)
+    losses = []
+    for i in range(5):
+        tokens = rng.integers(1, 128, (4, 32)).astype(np.int32)
+        labels, weights = shift_labels(tokens)
+        batch = {
+            "tokens": jax.device_put(tokens, sharding),
+            "labels": jax.device_put(labels, sharding),
+            "weights": jax.device_put(weights, sharding),
+        }
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert step.stats.calls == 5
+    assert step.stats.recompiles_after_warmup == 0
+    assert np.isfinite(losses).all()
+    assert int(jax.device_get(state.step)) == 5

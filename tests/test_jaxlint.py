@@ -1,0 +1,170 @@
+"""jaxlint: every rule fires exactly where the fixtures say, stays silent
+on clean/suppressed code, the baseline machinery works, and the CLI's
+exit codes hold — including exit 0 on the shipped package tree."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_tpu.analysis import (
+    load_baseline,
+    run_lint,
+    split_baselined,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "jaxlint")
+CLI = os.path.join(REPO, "scripts", "jaxlint.py")
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([a-z\-]+(?:\s*,\s*[a-z\-]+)*)")
+
+
+def expected_findings():
+    """{(relpath, line, rule)} parsed from the fixtures' EXPECT comments."""
+    out = set()
+    for dirpath, _dirs, files in os.walk(FIXTURES):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, FIXTURES).replace(os.sep, "/")
+            with open(path) as f:
+                for i, line in enumerate(f, start=1):
+                    m = _EXPECT_RE.search(line)
+                    if m:
+                        for rule in m.group(1).split(","):
+                            out.add((rel, i, rule.strip()))
+    return out
+
+
+def test_every_rule_fires_exactly_where_expected():
+    findings = run_lint([FIXTURES], rel_root=FIXTURES)
+    got = {(f.path, f.line, f.rule) for f in findings}
+    want = expected_findings()
+    assert want, "fixtures lost their EXPECT markers"
+    missing = want - got
+    spurious = got - want
+    assert not missing, f"rules failed to fire: {sorted(missing)}"
+    assert not spurious, f"false positives: {sorted(spurious)}"
+
+
+def test_clean_and_suppressed_fixtures_stay_silent():
+    for name in ("clean.py", "suppressed.py"):
+        findings = run_lint(
+            [os.path.join(FIXTURES, name)], rel_root=FIXTURES
+        )
+        assert findings == [], [f.render() for f in findings]
+
+
+def test_severities_and_rendering():
+    findings = run_lint([FIXTURES], rel_root=FIXTURES)
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["collective-axis"].severity == "error"
+    assert by_rule["host-transfer"].severity == "error"
+    assert by_rule["precision-cast"].severity == "warning"
+    r = by_rule["collective-axis"].render()
+    assert re.match(r"^bad_collectives\.py:\d+: collective-axis error: ", r)
+
+
+def test_baseline_split(tmp_path):
+    target = os.path.join(FIXTURES, "ops", "bad_precision.py")
+    findings = run_lint([target], rel_root=FIXTURES)
+    assert len(findings) == 4
+    with open(target) as f:
+        lines = f.read().splitlines()
+    entries = [
+        {
+            "rule": f.rule,
+            "file": f.path,
+            "line_content": lines[f.line - 1].strip(),
+            "reason": "reviewed in test",
+        }
+        for f in findings[:2]
+    ]
+    sources = {"ops/bad_precision.py": lines}
+    new, old = split_baselined(findings, entries, sources)
+    assert len(old) == 2 and len(new) == 2
+    # content-based matching: a drifted line no longer matches
+    entries[0]["line_content"] = "something.else()"
+    new, old = split_baselined(findings, entries, sources)
+    assert len(old) == 1 and len(new) == 3
+
+
+def test_shipped_baseline_entries_all_carry_reasons():
+    entries = load_baseline(os.path.join(REPO, "scripts", "jaxlint_baseline.json"))
+    assert entries, "shipped baseline unexpectedly empty"
+    for e in entries:
+        assert e["reason"].strip(), e
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, CLI, *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_cli_exit_1_on_fixture_violations():
+    res = _cli("--no-baseline", "--no-partition-coverage", FIXTURES)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "collective-axis" in res.stdout
+
+
+def test_cli_json_format():
+    res = _cli("--no-baseline", "--no-partition-coverage", "--format", "json",
+               FIXTURES)
+    data = json.loads(res.stdout)
+    assert data["baselined"] == []
+    assert any(f["rule"] == "recompile-traced-branch" for f in data["new"])
+
+
+def test_cli_list_rules():
+    res = _cli("--list-rules")
+    assert res.returncode == 0
+    for rule in ("collective-axis", "recompile-traced-branch",
+                 "host-transfer", "partition-coverage", "precision-cast"):
+        assert rule in res.stdout
+
+
+def test_cli_exit_0_on_shipped_tree():
+    """The acceptance gate: the package lints clean (fixed, suppressed
+    with reasons, or baselined) including the partition-coverage check."""
+    res = _cli(os.path.join(REPO, "pytorch_distributed_tpu"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 error(s), 0 warning(s)" in res.stdout
+
+
+# ---- partition coverage (runtime check against real param trees) ----
+
+
+def test_partition_coverage_clean_on_shipped_rules():
+    from pytorch_distributed_tpu.analysis.partition_coverage import (
+        check_partition_coverage,
+    )
+
+    findings = check_partition_coverage()
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_partition_coverage_catches_fallthrough_and_dead_rules():
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributed_tpu.analysis.partition_coverage import (
+        check_partition_coverage,
+    )
+
+    crippled = (
+        (r"attn/qkv/kernel", P(None, None, "model", None)),
+        (r"renamed_module/never_matches", P("model")),
+    )
+    findings = check_partition_coverage(rules=crippled)
+    messages = "\n".join(f.message for f in findings)
+    # the MLP kernels fell through to replicated...
+    assert "mlp_up/kernel" in messages and "mlp_down/kernel" in messages
+    # ...and the drifted pattern is called out as dead
+    assert "renamed_module/never_matches" in messages
+    assert all(f.rule == "partition-coverage" for f in findings)

@@ -22,7 +22,25 @@ import time
 
 import pytest
 
-pytestmark = pytest.mark.multihost
+# jaxlint triage (ANALYSIS.md, "multihost triage"): every case below spawns
+# a real 2-process jax.distributed run on the CPU backend, and this
+# jaxlib's CPU client cannot compile cross-process programs at all — the
+# first multihost-sharded device_put in the child dies with
+# "XlaRuntimeError: INVALID_ARGUMENT: Multiprocess computations aren't
+# implemented on the CPU backend" (see
+# analysis.guards.backend_supports_multiprocess). The collective-axis and
+# rendezvous lints come back clean on parallel/ and train/, so this is an
+# environment capability gap, not a code defect: xfail (not skip) so a
+# collectives-capable backend reports loudly via XPASS.
+_MULTIPROCESS_XFAIL = pytest.mark.xfail(
+    reason="jaxlint triage: jaxlib CPU backend lacks multiprocess "
+    "collectives ('Multiprocess computations aren't implemented on the "
+    "CPU backend'); rendezvous/collective-axis lints clean — see "
+    "ANALYSIS.md",
+    strict=False,
+)
+
+pytestmark = [pytest.mark.multihost, _MULTIPROCESS_XFAIL]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = os.path.join(REPO, "tests", "multihost_child.py")

@@ -162,7 +162,7 @@ def test_sgd_loss_trajectory_matches_torch():
         tl = crit(out, torch.from_numpy(y))
         tl.backward()
         opt.step()
-        torch_losses.append(float(tl))
+        torch_losses.append(float(tl.detach()))
 
         params, stats, opt_state, jl = step(
             params, stats, opt_state,
