@@ -33,6 +33,8 @@ from typing import Iterator, Optional
 import numpy as np
 
 from pytorch_distributed_tpu.data.sampler import DistributedSampler
+from pytorch_distributed_tpu.resilience.faults import fault_point
+from pytorch_distributed_tpu.resilience.retry import retry_call
 
 
 def _collate(samples) -> dict:
@@ -56,6 +58,7 @@ class DataLoader:
         prefetch: int = 2,
         seed: int = 0,
         collate_fn=None,
+        retries: int = 2,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -66,6 +69,7 @@ class DataLoader:
         self.drop_last = drop_last
         self.prefetch = max(prefetch, 1)
         self.seed = seed
+        self.retries = retries  # bounded re-fetch on transient OSError
         # default: image-classification (image, label) stacking; LM loaders
         # pass train.lm_trainer.lm_collate
         self.collate_fn = collate_fn or _collate
@@ -101,6 +105,11 @@ class DataLoader:
         return dataset[i]
 
     def _fetch(self, batch_indices: np.ndarray, pool) -> dict:
+        # injection site "data.fetch" (resilience.faults): a raise here is
+        # a transient read failure, absorbed by _fetch_retried's bounded
+        # retry — the deterministic per-sample RNG makes a re-fetch
+        # bit-identical to the first attempt
+        fault_point("data.fetch")
         ints = [int(i) for i in batch_indices]
         if hasattr(self.dataset, "collate_batch") and self.collate_fn is _collate:
             # Whole-batch fast path (e.g. RawImageNet's native C crop+
@@ -117,6 +126,16 @@ class DataLoader:
             samples = [self._getitem(i) for i in ints]
         return self.collate_fn(samples)
 
+    def _fetch_retried(self, batch_indices: np.ndarray, pool) -> dict:
+        """``_fetch`` under bounded backoff: a transient read failure
+        (OSError; injected faults included) re-fetches the SAME batch —
+        augmentation RNG derives from (seed, epoch, index), so the retry
+        reproduces it exactly. Non-OSError bugs propagate on first raise."""
+        return retry_call(
+            self._fetch, batch_indices, pool,
+            retries=self.retries, seed=self.seed, what="batch fetch",
+        )
+
     def iter_batches(self, start_batch: int = 0) -> Iterator[dict]:
         """Iterate batches of the current epoch, optionally seeking past the
         first ``start_batch`` batches at zero cost (step-resume). Each call
@@ -129,7 +148,7 @@ class DataLoader:
         try:
             if self.prefetch <= 1:
                 for idx in self._batches(start_batch):
-                    yield self._fetch(idx, pool)
+                    yield self._fetch_retried(idx, pool)
                 return
             # Bounded producer/consumer: host decode overlaps device compute.
             q: queue.Queue = queue.Queue(maxsize=self.prefetch)
@@ -141,7 +160,7 @@ class DataLoader:
                     for idx in self._batches(start_batch):
                         if stop.is_set():
                             return
-                        q.put(self._fetch(idx, pool))
+                        q.put(self._fetch_retried(idx, pool))
                 except BaseException as e:  # surfaced by consumer
                     q.put(e)
                     return
@@ -159,16 +178,23 @@ class DataLoader:
                     yield item
             finally:
                 stop.set()
-                # drain so the producer can observe stop and exit
-                while t.is_alive():
+                # Unblock the producer, then BLOCK on join: after stop is
+                # set it can enqueue at most one in-flight batch plus the
+                # _END/exception sentinel, and the queue (maxsize >= 2 on
+                # this path) absorbs both once drained — so the join
+                # terminates without the old 100 ms get_nowait poll spin.
+                while True:
                     try:
                         q.get_nowait()
                     except queue.Empty:
-                        pass
-                    t.join(timeout=0.1)
+                        break
+                t.join()
         finally:
             if pool is not None:
-                pool.shutdown(wait=False)
+                # cancel_futures: a cancelled iterator must not leave
+                # decode futures running against a dataset the caller may
+                # be about to close (teardown hardening)
+                pool.shutdown(wait=False, cancel_futures=True)
 
     def __iter__(self) -> Iterator[dict]:
         return self.iter_batches(0)

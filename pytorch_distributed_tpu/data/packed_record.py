@@ -33,6 +33,7 @@ from typing import Iterable, Sequence
 import numpy as np
 
 from pytorch_distributed_tpu.data import native
+from pytorch_distributed_tpu.resilience.retry import retry_call
 
 _MAGIC = b"TPRC"
 _VERSION = 1
@@ -182,16 +183,25 @@ class PackedRecordReader:
         return self.n
 
     def read(self, i: int, verify_crc: bool = True) -> bytes:
+        """One record. Transient read failures (a cluster-fs pread during
+        failover, a CRC mismatch from an in-flight page) get a bounded
+        seeded-backoff retry — both readers are stateless preads, so a
+        retry is a clean re-read."""
         if not 0 <= i < self.n:
             raise IndexError(i)
-        if self._native is not None:
-            return self._native.read(i, verify_crc)
-        return self._py.read(i, verify_crc)
+        reader = self._native if self._native is not None else self._py
+        return retry_call(
+            reader.read, i, verify_crc, what=f"record read {i}"
+        )
 
     def read_batch(self, indices: Sequence[int], verify_crc: bool = True) -> list[bytes]:
-        """Gather many records (single native call when available)."""
+        """Gather many records (single native call when available), with
+        the same bounded retry as ``read``."""
         if self._native is not None:
-            return self._native.read_batch(indices, verify_crc)
+            return retry_call(
+                self._native.read_batch, indices, verify_crc,
+                what="record batch read",
+            )
         return [self.read(int(i), verify_crc) for i in indices]
 
     def verify_all(self) -> None:

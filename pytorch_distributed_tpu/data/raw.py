@@ -233,10 +233,15 @@ class RawImageNet:
             lefts = [(w - s) // 2] * n
             flips = [False] * n
         from pytorch_distributed_tpu.data.native import SizeMismatch
+        from pytorch_distributed_tpu.resilience.retry import retry_call
 
         try:
-            images, labels = nat.crop_batch(
-                indices, tops, lefts, flips, s, h, w
+            # bounded retry on transient pread failures, mirroring
+            # PackedRecordReader.read; SizeMismatch is structural (not an
+            # OSError) and falls through to the Python path unretried
+            images, labels = retry_call(
+                nat.crop_batch, indices, tops, lefts, flips, s, h, w,
+                no_retry_on=(SizeMismatch,), what="raw batch crop",
             )
         except SizeMismatch:
             # variable-size split: the per-sample path reads true sizes.

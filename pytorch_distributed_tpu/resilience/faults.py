@@ -1,0 +1,233 @@
+"""Deterministic fault injection: one plan, named sites, exact replay.
+
+Chaos tooling that fires randomly cannot be asserted on; this plane is
+deterministic end to end so every failure a test provokes is reproducible
+bit-for-bit. A :class:`FaultPlan` is a list of :class:`FaultSpec` entries
+keyed by ``(site, occurrence)``: each named hazard site counts its own
+calls, and a spec fires on occurrences ``[at, at + times)`` of its site.
+No wall clock, no RNG — the nth call to a site fails the same way on every
+run.
+
+Hook placement is the contract: ``fault_point(site)`` sits at the real
+hazard sites of the framework, so the kill-matrix exercises exactly the
+states a production crash can leave behind:
+
+====================  =====================================================
+site                  placed at
+====================  =====================================================
+``data.fetch``        ``data/loader.py`` ``_fetch`` — a batch read
+``ckpt.shard_write``  ``utils/checkpoint.py`` shard write, after the tmp
+                      file is written but BEFORE the atomic publish — a
+                      kill here is the classic torn shard
+``ckpt.pre_commit``   immediately before rank 0's atomic manifest replace
+                      (the commit point) — data files landed, manifest not
+``ckpt.post_commit``  immediately after the manifest replace — the new
+                      checkpoint is live, stale-shard GC has not run
+``train.step``        the trainer loop, once per step before dispatch
+====================  =====================================================
+
+Fault kinds:
+
+- ``raise``   — raise :class:`InjectedFault` (an ``OSError``, so the
+  bounded retry in ``resilience.retry`` treats it as transient);
+- ``kill``    — ``SIGKILL`` the current process: no atexit, no finally
+  blocks, exactly what preemption or an OOM kill delivers;
+- ``hang``    — sleep ``seconds`` (synthetic stall for the watchdog);
+- ``nan``     — returned to the caller as a directive: the trainer poisons
+  the step's batch with NaNs (``poison_batch``) to provoke NaN gradients;
+- ``suspend`` — returned to the caller: the trainer latches its
+  ``SuspendWatcher``, exercising checkpoint-then-yield in-process.
+
+Configuration: ``install_plan(plan)`` in-process (tests), or the
+``PDT_FAULT_PLAN`` env var — inline JSON or ``@/path/to/plan.json`` — for
+subprocess children (the kill-matrix). JSON shape::
+
+    {"faults": [{"site": "ckpt.shard_write", "kind": "kill", "at": 2},
+                {"site": "data.fetch", "kind": "raise", "at": 1, "times": 2}]}
+
+``fault_point`` is a no-op returning None when no plan is installed — one
+attribute check, nothing measurable in the hot loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("pytorch_distributed_tpu")
+
+ENV_PLAN = "PDT_FAULT_PLAN"
+
+_KINDS = ("raise", "kill", "hang", "nan", "suspend")
+# kinds fault_point executes itself vs. returns for the caller to interpret
+_DIRECTIVES = ("nan", "suspend")
+
+
+class InjectedFault(OSError):
+    """A fault raised by the injection plane. Subclasses ``OSError`` so the
+    bounded retry path treats it exactly like the transient I/O error it
+    stands in for."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    kind: str
+    at: int = 0          # first occurrence (0-based call count of the site)
+    times: int = 1       # fire on occurrences [at, at + times)
+    seconds: float = 0.0  # hang duration
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: {_KINDS}"
+            )
+        if self.at < 0 or self.times < 1:
+            raise ValueError(
+                f"need at >= 0 and times >= 1, got at={self.at} "
+                f"times={self.times}"
+            )
+
+    def matches(self, occurrence: int) -> bool:
+        return self.at <= occurrence < self.at + self.times
+
+
+class FaultPlan:
+    """An immutable set of specs plus the per-site occurrence counters and
+    a log of every fault fired (``plan.fired``: ``(site, occurrence,
+    kind)`` tuples — tests assert on it)."""
+
+    def __init__(self, specs: List[FaultSpec]):
+        self.specs = list(specs)
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.fired: List[tuple] = []
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        data = json.loads(text)
+        return cls([FaultSpec(**spec) for spec in data.get("faults", [])])
+
+    @classmethod
+    def from_env(cls, env: str = ENV_PLAN) -> Optional["FaultPlan"]:
+        value = os.environ.get(env, "").strip()
+        if not value:
+            return None
+        if value.startswith("@"):
+            with open(value[1:]) as f:
+                value = f.read()
+        return cls.from_json(value)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"faults": [dataclasses.asdict(s) for s in self.specs]}
+        )
+
+    def tick(self, site: str) -> Optional[FaultSpec]:
+        """Count one occurrence of ``site``; return the matching spec, if
+        any. Thread-safe: shard writes run on background threads."""
+        with self._lock:
+            n = self._counts.get(site, 0)
+            self._counts[site] = n + 1
+            for spec in self.specs:
+                if spec.site == site and spec.matches(n):
+                    self.fired.append((site, n, spec.kind))
+                    return spec
+        return None
+
+
+# Installed plan: module-global, one per process. ``None`` + env-checked
+# means injection is off and fault_point is a single attribute test.
+_plan: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install (or clear, with None) the process-wide plan. Returns it."""
+    global _plan, _env_checked
+    _plan = plan
+    _env_checked = True  # an explicit install overrides the env
+    return plan
+
+
+def clear_plan() -> None:
+    global _plan, _env_checked
+    _plan = None
+    _env_checked = False
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, lazily loading ``PDT_FAULT_PLAN`` once."""
+    global _plan, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        _plan = FaultPlan.from_env()
+        if _plan is not None:
+            logger.warning(
+                "fault injection active from $%s: %d spec(s)",
+                ENV_PLAN, len(_plan.specs),
+            )
+    return _plan
+
+
+def fault_point(site: str) -> Optional[FaultSpec]:
+    """The injection hook. Executes ``raise``/``kill``/``hang`` faults
+    itself; returns directive specs (``nan``, ``suspend``) for the caller
+    to interpret; returns None when nothing fires."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    spec = plan.tick(site)
+    if spec is None:
+        return None
+    if spec.kind == "raise":
+        raise InjectedFault(f"injected fault at {site} (at={spec.at})")
+    if spec.kind == "kill":
+        logger.warning("injected SIGKILL at %s", site)
+        # flush logs; SIGKILL runs no atexit/finally — that is the point
+        logging.shutdown()
+        os.kill(os.getpid(), signal.SIGKILL)
+    if spec.kind == "hang":
+        logger.warning("injected %.1fs hang at %s", spec.seconds, site)
+        time.sleep(spec.seconds)
+        return None
+    if spec.kind in _DIRECTIVES:
+        logger.warning("injected %s directive at %s", spec.kind, site)
+        return spec
+    return None
+
+
+def poison_batch(batch: Any) -> Any:
+    """NaN-fill every inexact-dtype array of a host batch — the ``nan``
+    directive's payload. Poisoning the *input* (not the state) provokes
+    NaN loss and NaN gradients through the real compiled step, which is
+    what the stepguard must catch; integer arrays (tokens, labels) pass
+    through untouched."""
+    import numpy as np
+
+    poisoned = False
+
+    def leaf(x):
+        nonlocal poisoned
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            poisoned = True
+            return np.full_like(arr, np.nan)
+        return x
+
+    import jax
+
+    out = jax.tree.map(leaf, batch)
+    if not poisoned:
+        raise ValueError(
+            "poison_batch found no float leaf to NaN-fill; the nan fault "
+            "needs a float field in the batch (e.g. LM loss weights or "
+            "float images)"
+        )
+    return out

@@ -1,0 +1,132 @@
+"""Step guard: skip non-finite updates in-jit, roll back after K in a row.
+
+A single NaN step — a corrupt record, an overflowed bf16 activation, a
+cosmic-ray flip — must not kill a production run, and it must not poison
+the parameters either. Two layers, split by where they can afford to run:
+
+**In the compiled step** (:func:`finite_ok` + :func:`guard_state`): the
+step builders (``train/step.py``, ``train/lm.py``) compute a replicated
+``good`` flag from the globally-reduced loss and the combined gradients
+and select old-vs-new state with ``lax.cond`` — params, optimizer state
+and BN stats keep their pre-step values on a bad step, while ``step``
+still advances (mirroring torch GradScaler's skip semantics,
+``resnet_ddp_apex.py:30-33``). Everything stays on device: no ``float()``,
+no ``.item()``, no host round trip in the hot path — the flag is returned
+as one more replicated metric (``step_good``).
+
+**On the host** (:class:`StepGuard`): a lag-1 policy loop. The trainer
+hands each step's ``step_good`` device scalar to ``observe``; the guard
+reads the value from the *previous* step — already materialized, so the
+read never stalls dispatch of the current one — counts consecutive bad
+steps, and raises :class:`RollbackRequested` once ``max_bad_steps`` hit in
+a row. The trainers catch it, restore the newest restorable checkpoint,
+and re-enter the epoch loop. Skip handles a transient; rollback handles
+the case where skipping isn't enough (the state itself, or the data
+stream, has gone bad).
+
+Multi-host safety: ``step_good`` is derived from psum'd loss and the
+post-combine gradients (with an explicit ``pmin`` over every mesh axis
+where shards can disagree), so every process observes the identical flag
+sequence and raises RollbackRequested at the same step — no rank ever
+rolls back alone into a mismatched-collective hang.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def finite_ok(loss, grads=None) -> jax.Array:
+    """Scalar bool: the step's loss (and, if given, every float gradient
+    leaf) is finite. Pure jnp — safe inside the compiled step."""
+    good = jnp.isfinite(jnp.asarray(loss)).all()
+    if grads is not None:
+        from pytorch_distributed_tpu.ops.precision import all_finite
+
+        good = jnp.logical_and(good, all_finite(grads))
+    return good
+
+
+def guard_state(good, new_state, old_state, keep=("step",)):
+    """Select the whole post-update state on a good step, the pre-update
+    state on a bad one — via ``lax.cond`` so the selection is a single
+    branch in the compiled program. Fields named in ``keep`` always come
+    from ``new_state``: ``step`` advances on skipped steps (a skip is a
+    consumed batch, same as torch GradScaler), and callers running a
+    dynamic loss scaler pass ``("step", "scaler")`` so backoff still
+    happens on the skipped step."""
+    selected = jax.lax.cond(
+        good,
+        lambda pair: pair[0],
+        lambda pair: pair[1],
+        (new_state, old_state),
+    )
+    kept = {k: getattr(new_state, k) for k in keep if hasattr(new_state, k)}
+    return selected.replace(**kept) if kept else selected
+
+
+class RollbackRequested(RuntimeError):
+    """Raised by :class:`StepGuard` when ``max_bad_steps`` consecutive
+    steps were skipped — the trainer restores the last good checkpoint."""
+
+    def __init__(self, bad_steps: int):
+        super().__init__(
+            f"{bad_steps} consecutive non-finite train steps; rolling back "
+            "to the last good checkpoint"
+        )
+        self.bad_steps = bad_steps
+
+
+class StepGuard:
+    """Host-side skip accounting and the rollback trigger.
+
+    ``observe(step_good)`` enqueues the device scalar and reads the one
+    ``lag`` steps old (materialized by then — reading it does not stall
+    the pipeline). ``flush()`` drains the queue at epoch end. Counters:
+    ``bad_total`` (skipped steps this run), ``bad_consecutive`` (current
+    streak), ``rollbacks`` (times RollbackRequested fired).
+    """
+
+    def __init__(self, max_bad_steps: int = 0, lag: int = 1):
+        if lag < 0:
+            raise ValueError(f"lag must be >= 0, got {lag}")
+        self.max_bad_steps = int(max_bad_steps)
+        self.lag = int(lag)
+        self._pending: list = []
+        self.bad_total = 0
+        self.bad_consecutive = 0
+        self.rollbacks = 0
+
+    def _ingest(self, value) -> None:
+        if float(jax.device_get(value)) > 0.0:
+            self.bad_consecutive = 0
+            return
+        self.bad_total += 1
+        self.bad_consecutive += 1
+        if self.max_bad_steps and self.bad_consecutive >= self.max_bad_steps:
+            self.rollbacks += 1
+            bad, self.bad_consecutive = self.bad_consecutive, 0
+            self._pending.clear()  # stale flags die with the rolled-back run
+            raise RollbackRequested(bad)
+
+    def observe(self, step_good: Optional[jax.Array]) -> None:
+        """Feed one step's replicated ``step_good`` metric. Raises
+        :class:`RollbackRequested` when the streak limit is hit."""
+        if step_good is None:
+            return
+        self._pending.append(step_good)
+        while len(self._pending) > self.lag:
+            self._ingest(self._pending.pop(0))
+
+    def flush(self) -> None:
+        """Drain the lag window (epoch end / before validation)."""
+        while self._pending:
+            self._ingest(self._pending.pop(0))
+
+    def reset(self) -> None:
+        """Forget the streak (after a rollback restored good state)."""
+        self._pending.clear()
+        self.bad_consecutive = 0

@@ -1,0 +1,181 @@
+"""Per-step deadline watchdog: stacks on stall, then the suspend path.
+
+A hung collective (one host dropped out of a psum), a deadlocked data
+loader, or an NFS mount that stopped answering all present the same way:
+the step loop simply stops, forever, with zero diagnostics — the failure
+mode the multihost triage in ANALYSIS.md calls the worst to debug. The
+watchdog converts that silence into evidence and (optionally) a clean
+yield:
+
+- the trainer calls ``beat()`` once per step; a dedicated daemon thread
+  checks the deadline;
+- on stall it dumps **every thread's stack** (``sys._current_frames``) to
+  the log and an optional file — the post-mortem shows exactly which
+  frame is stuck (a ``q.get``, a collective, a ``pread``);
+- optionally latches the existing :class:`SuspendWatcher`, so a *soft*
+  stall (data loader wedged, filesystem slow) flows into the proven
+  checkpoint-then-yield path at the next step; a *hard* stall (the device
+  program itself is hung) can't reach that poll again, so ``exit_code``
+  forces ``os._exit`` after a grace period and the scheduler relaunches
+  into crash recovery — which the kill-matrix proves restores correctly.
+
+One stall fires one dump (re-armed by the next beat), so a long stall
+doesn't spray logs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+logger = logging.getLogger("pytorch_distributed_tpu")
+
+
+def dump_all_stacks() -> str:
+    """Format every live thread's current stack (the stall post-mortem)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in sys._current_frames().items():
+        parts.append(
+            f"--- thread {names.get(ident, '?')} ({ident}) ---\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    return "\n".join(parts)
+
+
+class Watchdog:
+    """Deadline watchdog over a heartbeat.
+
+    ``timeout_s``   stall threshold between ``beat()`` calls.
+    ``watcher``     optional ``SuspendWatcher``: on stall,
+                    ``request_suspend()`` is latched so a recovered loop
+                    checkpoints and yields at its next poll.
+    ``dump_path``   also write the stack dump to this file (atomic-ish
+                    append; the kill-matrix parent reads it).
+    ``on_stall``    optional callback (tests; checkpoint-and-exit hooks).
+    ``exit_code``   if not None, ``os._exit(exit_code)`` ``grace_s`` after
+                    a stall that no beat cleared — the hard-hang escape
+                    hatch; the scheduler's relaunch resumes from the last
+                    complete checkpoint.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        *,
+        watcher=None,
+        dump_path: Optional[str] = None,
+        on_stall: Optional[Callable[[str], None]] = None,
+        exit_code: Optional[int] = None,
+        grace_s: float = 10.0,
+        poll_s: Optional[float] = None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.watcher = watcher
+        self.dump_path = dump_path
+        self.on_stall = on_stall
+        self.exit_code = exit_code
+        self.grace_s = float(grace_s)
+        self.poll_s = float(poll_s) if poll_s else min(
+            1.0, self.timeout_s / 4.0
+        )
+        self.stalls = 0
+        self._last = time.monotonic()
+        self._armed = False  # becomes True at the first beat
+        self._fired = False  # one dump per stall
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="pdt-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the heartbeat -----------------------------------------------------
+
+    def beat(self) -> None:
+        """One step completed; re-arm the deadline. Cheap: one clock read
+        and two attribute stores."""
+        self._last = time.monotonic()
+        self._armed = True
+        self._fired = False
+
+    # -- the watcher thread ------------------------------------------------
+
+    def _run(self) -> None:
+        stall_at: Optional[float] = None
+        while not self._stop.wait(self.poll_s):
+            if not self._armed:
+                continue
+            stalled = time.monotonic() - self._last
+            if stalled < self.timeout_s:
+                stall_at = None
+                continue
+            if not self._fired:
+                self._fired = True
+                self.stalls += 1
+                stall_at = time.monotonic()
+                self._handle_stall(stalled)
+            elif (
+                self.exit_code is not None
+                and stall_at is not None
+                and time.monotonic() - stall_at >= self.grace_s
+            ):
+                logger.error(
+                    "watchdog: stall persisted %.1fs past the dump; "
+                    "os._exit(%d) for scheduler relaunch",
+                    self.grace_s, self.exit_code,
+                )
+                logging.shutdown()
+                os._exit(self.exit_code)
+
+    def _handle_stall(self, stalled_s: float) -> None:
+        dump = dump_all_stacks()
+        logger.error(
+            "watchdog: no step heartbeat for %.1fs (deadline %.1fs); "
+            "all-thread stacks:\n%s",
+            stalled_s, self.timeout_s, dump,
+        )
+        if self.dump_path:
+            try:
+                with open(self.dump_path, "a") as f:
+                    f.write(
+                        f"=== watchdog stall #{self.stalls} "
+                        f"({stalled_s:.1f}s) ===\n{dump}\n"
+                    )
+            except OSError as e:
+                logger.error("watchdog: could not write dump: %s", e)
+        if self.watcher is not None:
+            # soft-stall path: the next step's suspend poll checkpoints
+            # and yields through the existing, tested machinery
+            self.watcher.request_suspend()
+        if self.on_stall is not None:
+            try:
+                self.on_stall(dump)
+            except Exception:
+                logger.exception("watchdog: on_stall callback failed")

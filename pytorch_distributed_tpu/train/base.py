@@ -22,11 +22,94 @@ import jax
 import numpy as np
 
 from pytorch_distributed_tpu.parallel import collectives, mesh as mesh_lib
+from pytorch_distributed_tpu.resilience import faults
+from pytorch_distributed_tpu.resilience.stepguard import (
+    RollbackRequested,
+    StepGuard,
+)
+from pytorch_distributed_tpu.resilience.watchdog import Watchdog
 from pytorch_distributed_tpu.utils.logging import rank0_print
 
 
 class SuspendableTrainer:
     """Mixin implementing suspend agreement, payloads, and resume."""
+
+    # resilience attributes; _init_resilience overrides them per config
+    guard = None
+    watchdog = None
+    rollbacks = 0
+
+    # ---- resilience plumbing (resilience/: stepguard, watchdog, faults).
+    # Both trainers call _init_resilience from __init__ and bracket each
+    # train step with _pre_step/_post_step; fit() catches
+    # RollbackRequested and re-enters via _rollback. ----
+
+    def _init_resilience(self) -> None:
+        """Build the step guard and watchdog the config asks for. The
+        guard exists whenever the compiled step emits ``step_good``
+        (``nan_guard=True``); ``max_bad_steps=0`` means skip-only, no
+        rollback."""
+        cfg = self.config
+        if getattr(cfg, "nan_guard", False):
+            self.guard = StepGuard(
+                max_bad_steps=getattr(cfg, "max_bad_steps", 0)
+            )
+        timeout = getattr(cfg, "watchdog_timeout_s", 0.0)
+        if timeout and timeout > 0:
+            self.watchdog = Watchdog(
+                timeout,
+                watcher=self.watcher,
+                dump_path=os.path.join(cfg.save_dir, "watchdog_stall.log")
+                if jax.process_index() == 0
+                else None,
+            ).start()
+
+    def _pre_step(self, host_batch):
+        """Once per train step, before device dispatch: apply any
+        ``train.step`` fault directive — ``nan`` poisons the host batch
+        (provoking NaN grads through the real compiled step), ``suspend``
+        latches the watcher; ``kill``/``hang``/``raise`` execute inside
+        fault_point itself."""
+        spec = faults.fault_point("train.step")
+        if spec is not None:
+            if spec.kind == "nan":
+                host_batch = faults.poison_batch(host_batch)
+            elif spec.kind == "suspend":
+                self.watcher.request_suspend()
+        return host_batch
+
+    def _post_step(self, metrics: dict) -> None:
+        """After each step's dispatch: heartbeat the watchdog (beating
+        here, not in _pre_step, keeps the first step's multi-second XLA
+        compile outside the armed deadline window) and feed the guard its
+        lagged ``step_good`` flag. The guard raises RollbackRequested
+        (caught in fit) after K consecutive bad steps — deterministically
+        on every rank, since the flag is a replicated psum'd metric."""
+        if self.watchdog is not None:
+            self.watchdog.beat()
+        if self.guard is not None:
+            self.guard.observe(metrics.get("step_good"))
+
+    def _epoch_end_guard(self) -> None:
+        if self.guard is not None:
+            self.guard.flush()
+
+    def _rollback(self, err: RollbackRequested) -> None:
+        """Restore the newest restorable checkpoint after the guard gave
+        up on skipping. Every rank raises at the same step (replicated
+        metric) and reaches this together, so the collective-ordered
+        resume path is safe. No checkpoint at all is fatal: training from
+        a state the guard condemned would just NaN again."""
+        self.rollbacks += 1
+        rank0_print(f"stepguard: {err}; restoring last good checkpoint")
+        self.ckpt.wait()  # commit/join any in-flight save first
+        if not self.try_resume():
+            raise RuntimeError(
+                "stepguard requested rollback but no restorable checkpoint "
+                "exists — enable save_every_n_steps (or suspend saves) so "
+                "a rollback target is available"
+            ) from err
+        self.guard.reset()
 
     # ---- checkpoint payloads (collective: call on ALL ranks) ----
 
@@ -74,35 +157,53 @@ class SuspendableTrainer:
         reference lacks, so a crash after them must not fall back to an
         older suspend artifact).
 
+        Fallback restore: candidates are pre-validated (manifest + shard
+        completeness + save token) and scanned newest-first; a candidate
+        that still fails at load time — e.g. a token mismatch surfacing
+        mid-read — is logged and the scan falls through to the next
+        *complete* checkpoint instead of refusing to start. Validation
+        reads the same shared-fs files on every rank, so all ranks pick
+        the same candidate.
+
         Sharded directories restore shard-wise (each process reads only the
         blocks its devices need); legacy single files restore via the old
         full-numpy path."""
-        from pytorch_distributed_tpu.utils.checkpoint import load_sharded
+        from pytorch_distributed_tpu.utils.checkpoint import (
+            load_checkpoint,
+            load_sharded,
+        )
 
         self.ckpt.wait()
-        path = self.ckpt.newest_restorable()
-        if path is None:
-            return False
-        if os.path.isdir(path):
-            template = self._payload_live(0, 0)
-            state_sh = self._state_shardings()
-            shardings = jax.tree.map(lambda _: False, template)
-            shardings["state"] = state_sh
-            restored = load_sharded(path, template, shardings)
-            self.state = jax.device_put(restored["state"], state_sh)
-        else:
-            restored = self.ckpt.load_latest(self._payload(0, 0))
-            self.state = jax.device_put(
-                restored["state"], self._state_shardings()
+        for path in self.ckpt.restorable_paths():
+            try:
+                if os.path.isdir(path):
+                    template = self._payload_live(0, 0)
+                    state_sh = self._state_shardings()
+                    shardings = jax.tree.map(lambda _: False, template)
+                    shardings["state"] = state_sh
+                    restored = load_sharded(path, template, shardings)
+                    state = jax.device_put(restored["state"], state_sh)
+                else:
+                    restored = load_checkpoint(path, self._payload(0, 0))
+                    state = jax.device_put(
+                        restored["state"], self._state_shardings()
+                    )
+            except (OSError, ValueError, KeyError, RuntimeError) as e:
+                rank0_print(
+                    f"resume: {path} failed to load ({e}); falling back "
+                    "to the next complete checkpoint"
+                )
+                continue
+            self.state = state
+            self.start_epoch = int(restored["epoch"])
+            self.start_step = int(restored["step"])
+            self._restore_extra(restored)
+            rank0_print(
+                f"resumed from {path}: "
+                f"epoch {self.start_epoch} step {self.start_step}"
             )
-        self.start_epoch = int(restored["epoch"])
-        self.start_step = int(restored["step"])
-        self._restore_extra(restored)
-        rank0_print(
-            f"resumed from {path}: "
-            f"epoch {self.start_epoch} step {self.start_step}"
-        )
-        return True
+            return True
+        return False
 
     def _maybe_save_step(self, epoch: int, step: int) -> None:
         """Interval checkpoint hook: every ``save_every_n_steps`` train

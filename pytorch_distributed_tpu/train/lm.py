@@ -39,6 +39,7 @@ from pytorch_distributed_tpu.parallel.mesh import (
     SEQ_AXIS,
     shard_map,
 )
+from pytorch_distributed_tpu.resilience.stepguard import finite_ok, guard_state
 from pytorch_distributed_tpu.train.state import TrainState
 
 
@@ -417,6 +418,7 @@ def make_lm_train_step(
     fsdp: bool = False,
     fused_ce: bool = True,
     fused_ce_block_n: int = 512,
+    nan_guard: bool = False,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -444,6 +446,14 @@ def make_lm_train_step(
     Numerically it accumulates logits in fp32 where the unfused path
     materialized bf16 — equal-or-better. ``fused_ce=False`` or
     ``config=None`` keeps the materialized-logits path.
+
+    ``nan_guard`` adds the resilience finite gate (resilience.stepguard):
+    a non-finite global loss or gradient keeps the pre-step params and
+    optimizer state via an on-device ``lax.cond`` select (``step`` still
+    advances) and emits the replicated ``step_good`` metric. The verdict
+    is ``pmin``'d over EVERY mesh axis: TP/EP-sharded gradient leaves
+    legitimately differ across their axes, and a NaN visible to only one
+    shard must flip the decision for all of them.
     """
     if config is not None:
         check_seq_parallel_attention(mesh, config, seq_axis)
@@ -575,6 +585,19 @@ def make_lm_train_step(
             opt_state=new_opt_state,
         )
         metrics = {"loss": loss, "tokens": count}
+        if nan_guard:
+            # pmin over every mesh axis: TP/EP gradient shards differ per
+            # axis, and one shard's NaN must veto the update everywhere —
+            # otherwise devices diverge on the select and the state splits
+            good = (
+                jax.lax.pmin(
+                    finite_ok(loss, grads).astype(jnp.int32),
+                    tuple(mesh.axis_names),
+                )
+                > 0
+            )
+            new_state = guard_state(good, new_state, state)
+            metrics["step_good"] = good.astype(jnp.float32)
         if grad_norm is not None:
             metrics["grad_norm"] = grad_norm  # PRE-clip norm observable
         moe_stats = jax.tree.leaves(mutated.get("moe_stats", {}))

@@ -130,6 +130,14 @@ class LMTrainerConfig:
     # sharded step-<global_step>.ckpt saves with keep-last-K retention.
     save_every_n_steps: int = 0
     keep_last_ckpts: int = 3
+    # Resilience guards — see TrainerConfig: compiled finite gate
+    # (skip-on-NaN, no host sync), rollback after max_bad_steps
+    # consecutive bad steps, per-step deadline watchdog. nan_guard does
+    # not compose with pipeline_stages (the GPipe executor owns its own
+    # update path).
+    nan_guard: bool = False
+    max_bad_steps: int = 0
+    watchdog_timeout_s: float = 0.0
 
 
 class LMTrainer(SuspendableTrainer):
@@ -184,6 +192,11 @@ class LMTrainer(SuspendableTrainer):
         tx = build_optimizer(
             config.optimizer, schedule, weight_decay=config.weight_decay
         )
+        if config.pipeline_stages > 0 and config.nan_guard:
+            raise ValueError(
+                "nan_guard does not compose with pipeline_stages: the "
+                "GPipe executor owns its own update path (train/pp.py)"
+            )
         if config.pipeline_stages > 0:
             from pytorch_distributed_tpu.train.pp import (
                 create_pp_lm_state,
@@ -293,6 +306,7 @@ class LMTrainer(SuspendableTrainer):
                 dropout_seed=config.seed,
                 grad_clip_norm=config.grad_clip_norm,
                 fsdp=config.fsdp,
+                nan_guard=config.nan_guard,
             )
             self.eval_step = make_lm_eval_step(
                 self.mesh, state_specs=self.state_specs, config=model_config,
@@ -306,6 +320,7 @@ class LMTrainer(SuspendableTrainer):
         self.best_ppl = float("inf")
         self.start_epoch = 0
         self.start_step = 0
+        self._init_resilience()  # stepguard + watchdog per config
         self.metrics_log = MetricsLogger(
             os.path.join(config.save_dir, "metrics.jsonl")
             if jax.process_index() == 0
@@ -331,11 +346,13 @@ class LMTrainer(SuspendableTrainer):
         for step, host_batch in enumerate(
             self.train_loader.iter_batches(start_step), start=start_step
         ):
+            host_batch = self._pre_step(host_batch)
             batch = shard_lm_batch(
                 self.mesh, host_batch,
                 layout=self.model_config.ring_layout,
             )
             self.state, metrics = self.train_step(self.state, batch)
+            self._post_step(metrics)
             steps_done += 1
             if cfg.log_every and step % cfg.log_every == 0:
                 last = {k: float(v) for k, v in metrics.items()}
@@ -347,6 +364,7 @@ class LMTrainer(SuspendableTrainer):
                                      **last)
             self._maybe_save_step(epoch, step)
             self._maybe_suspend(epoch, step)
+        self._epoch_end_guard()  # drain the guard's lag window
         if steps_done:
             float(self.state.step)  # drain async dispatch before the clock
             elapsed = time.perf_counter() - t0
@@ -405,13 +423,26 @@ class LMTrainer(SuspendableTrainer):
                 "tokens": tokens}
 
     def fit(self) -> dict:
+        """Re-entrant epoch loop — see ``Trainer.fit``: RollbackRequested
+        from the step guard restores the last good checkpoint and resumes
+        from its epoch/step, identically on every rank."""
+        from pytorch_distributed_tpu.resilience.stepguard import (
+            RollbackRequested,
+        )
+
         self.try_resume()
         summary: dict = {}
-        for epoch in range(self.start_epoch, self.config.epochs):
+        epoch = self.start_epoch
+        while epoch < self.config.epochs:
             t0 = time.time()
             self.train_sampler.set_epoch(epoch)
             start_step = self.start_step if epoch == self.start_epoch else 0
-            self.train_epoch(epoch, start_step)
+            try:
+                self.train_epoch(epoch, start_step)
+            except RollbackRequested as err:
+                self._rollback(err)  # restores state + start_epoch/step
+                epoch = self.start_epoch
+                continue
             # commit last epoch's pending best-save: its file write
             # overlapped this epoch's training; all ranks reach this point
             # together, so the commit barrier is safely ordered
@@ -434,7 +465,10 @@ class LMTrainer(SuspendableTrainer):
                 rank0_print(f"new best ppl {self.best_ppl:.3f}, saved best.ckpt")
             self.metrics_log.log(kind="val", epoch=epoch,
                                  epoch_s=time.time() - t0, **summary)
+            epoch += 1
         self.ckpt.wait()  # commit any pending best-save before returning
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self.start_step = 0
         summary["best_ppl"] = self.best_ppl
         return summary

@@ -40,6 +40,7 @@ from pytorch_distributed_tpu.ops.metrics import ClassificationMetrics
 from pytorch_distributed_tpu.ops.precision import NoOpLossScaler, all_finite
 from pytorch_distributed_tpu.ops.optim import clip_grads_by_global_norm
 from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS, shard_map
+from pytorch_distributed_tpu.resilience.stepguard import finite_ok, guard_state
 from pytorch_distributed_tpu.train.state import TrainState
 
 
@@ -65,6 +66,7 @@ def make_train_step(
     label_smoothing: float = 0.0,
     state_specs: Optional[TrainState] = None,
     grad_clip_norm: float = 0.0,
+    nan_guard: bool = False,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build the compiled training step for a mesh.
 
@@ -78,6 +80,12 @@ def make_train_step(
     ``axis``; the step all_gathers params before the forward and
     psum_scatters gradients back to their owners — same math as replicated
     DP (all_gather∘psum_scatter ≡ pmean), ~axis-size less state memory.
+
+    ``nan_guard`` adds the resilience finite gate (resilience.stepguard):
+    a step whose global loss or combined gradients are non-finite keeps
+    the pre-step params/opt/BN state (``lax.cond`` select on device — no
+    host sync) while ``step`` still advances, and the replicated
+    ``step_good`` metric reports the verdict for the host rollback policy.
     """
     fsdp = state_specs is not None
     if fsdp:
@@ -185,6 +193,27 @@ def make_train_step(
             "count": batch_metrics.count,
             "grads_finite": finite.astype(jnp.float32),
         }
+        if nan_guard:
+            # The resilience finite gate. pmin over the axis: under FSDP
+            # each device checks only its gradient shards, and devices
+            # disagreeing on `good` would silently diverge params — the
+            # same global-agreement argument as the fp16 scaler gate.
+            good = (
+                jax.lax.pmin(
+                    finite_ok(metrics["loss"], grads).astype(jnp.int32),
+                    axis,
+                )
+                > 0
+            )
+            # step always advances (a skip is a consumed batch); the fp16
+            # scaler still backs off on the skipped step
+            keep = (
+                ("step",)
+                if isinstance(state.scaler, NoOpLossScaler)
+                else ("step", "scaler")
+            )
+            new_state = guard_state(good, new_state, state, keep=keep)
+            metrics["step_good"] = good.astype(jnp.float32)
         return new_state, metrics
 
     state_spec = state_specs if fsdp else P()

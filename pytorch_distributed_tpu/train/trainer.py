@@ -84,6 +84,16 @@ class TrainerConfig:
     # restorable checkpoint (train/base.py, utils/checkpoint.py round 5).
     save_every_n_steps: int = 0
     keep_last_ckpts: int = 3
+    # Resilience guards (resilience/, ANALYSIS.md "Failure model"):
+    # nan_guard compiles a finite gate into the train step — a non-finite
+    # loss/grad step keeps the pre-step params on device (lax.cond, no
+    # host sync) and reports step_good; after max_bad_steps consecutive
+    # bad steps (0 = never) the trainer rolls back to the last good
+    # checkpoint. watchdog_timeout_s > 0 arms a per-step deadline thread
+    # that dumps all-thread stacks on stall and latches the suspend path.
+    nan_guard: bool = False
+    max_bad_steps: int = 0
+    watchdog_timeout_s: float = 0.0
 
 
 class Trainer(SuspendableTrainer):
@@ -179,6 +189,7 @@ class Trainer(SuspendableTrainer):
             label_smoothing=config.label_smoothing,
             state_specs=self.state_specs,
             grad_clip_norm=config.grad_clip_norm,
+            nan_guard=config.nan_guard,
         )
         self.eval_step = make_eval_step(self.mesh, state_specs=self.state_specs)
         # pre-fault the checkpoint snapshot arena while the first step
@@ -189,6 +200,7 @@ class Trainer(SuspendableTrainer):
         self.best_acc = 0.0
         self.start_epoch = 0
         self.start_step = 0
+        self._init_resilience()  # stepguard + watchdog per config
 
         # Observability (SURVEY.md §5: the reference has only time.time()
         # prints; we keep those AND stream machine-readable metrics).
@@ -221,8 +233,10 @@ class Trainer(SuspendableTrainer):
         for step, host_batch in enumerate(
             self.train_loader.iter_batches(start_step), start=start_step
         ):
+            host_batch = self._pre_step(host_batch)
             batch = mesh_lib.shard_batch(self.mesh, host_batch)
             self.state, metrics = self.train_step(self.state, batch)
+            self._post_step(metrics)
             steps_done += 1
             if cfg.log_every and step % cfg.log_every == 0:
                 last = {k: float(v) for k, v in metrics.items()}
@@ -237,6 +251,7 @@ class Trainer(SuspendableTrainer):
                 )
             self._maybe_save_step(epoch, step)
             self._maybe_suspend(epoch, step)
+        self._epoch_end_guard()  # drain the guard's lag window
         if steps_done:
             # Drain the async dispatch queue with a value fetch before
             # reading the clock — per-step host timestamps would measure
@@ -278,19 +293,37 @@ class Trainer(SuspendableTrainer):
 
     def fit(self) -> dict:
         """Full run: resume → epochs → validate → best tracking → timing
-        (ref ``main`` of every recipe, e.g. ``restnet_ddp.py:135-150``)."""
+        (ref ``main`` of every recipe, e.g. ``restnet_ddp.py:135-150``).
+
+        The epoch loop is re-entrant for rollback: when the step guard
+        condemns the run (``RollbackRequested`` after ``max_bad_steps``
+        consecutive non-finite steps), the last good checkpoint is
+        restored and the loop continues from ITS epoch/step — which may
+        rewind epochs. Every rank takes the same path (replicated guard
+        metric), preserving collective ordering."""
+        from pytorch_distributed_tpu.resilience.stepguard import (
+            RollbackRequested,
+        )
+
         self.try_resume()
         summary: dict = {}
-        for epoch in range(self.start_epoch, self.config.epochs):
+        first_epoch = self.start_epoch  # trace only the first epoch run
+        epoch = self.start_epoch
+        while epoch < self.config.epochs:
             t0 = time.time()
             self.train_sampler.set_epoch(epoch)  # ref restnet_ddp.py:137
             start_step = self.start_step if epoch == self.start_epoch else 0
             # jax.profiler capture when PDT_TRACE_DIR is set — first epoch of
             # this run only (tracing all epochs would buffer multi-GB of
             # events on the host).
-            with trace(enabled=bool(os.environ.get("PDT_TRACE_DIR"))
-                       and epoch == self.start_epoch):
-                self.train_epoch(epoch, start_step)
+            try:
+                with trace(enabled=bool(os.environ.get("PDT_TRACE_DIR"))
+                           and epoch == first_epoch):
+                    self.train_epoch(epoch, start_step)
+            except RollbackRequested as err:
+                self._rollback(err)  # restores state + start_epoch/step
+                epoch = self.start_epoch
+                continue
             # commit last epoch's pending best-save: its file write
             # overlapped this epoch's training; all ranks reach this point
             # together, so the commit barrier is safely ordered
@@ -318,7 +351,10 @@ class Trainer(SuspendableTrainer):
             self.metrics_log.log(
                 kind="val", epoch=epoch, epoch_s=epoch_s, **summary
             )
+            epoch += 1
         self.ckpt.wait()  # commit any pending best-save before returning
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self.start_step = 0
         summary["best_acc"] = self.best_acc
         return summary

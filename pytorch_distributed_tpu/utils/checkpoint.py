@@ -32,6 +32,9 @@ import jax
 import numpy as np
 from flax import serialization
 
+from pytorch_distributed_tpu.resilience.faults import fault_point
+from pytorch_distributed_tpu.resilience.retry import retry_call
+
 LATEST = "latest.ckpt"
 BEST = "best.ckpt"
 
@@ -401,7 +404,13 @@ class _ShardedSave:
 
     def write(self) -> None:
         """Write this process's token-named shard file. Pure file I/O —
-        thread-safe, no jax calls."""
+        thread-safe, no jax calls. Transient I/O errors are retried with
+        bounded backoff (each attempt rewrites the tmp file from the still
+        -held snapshot, so a partial attempt is never published)."""
+        retry_call(self._write_once, what=f"shard write {self.fname}")
+        self.my_blocks = {}  # release the host snapshot
+
+    def _write_once(self) -> None:
         # raw byte views (bf16 etc. have no numpy descr; the manifest
         # carries the true dtype) — np.savez streams each buffer to disk
         fname = os.path.join(self.dirpath, self.fname)
@@ -422,8 +431,11 @@ class _ShardedSave:
             )
             f.flush()
             os.fsync(f.fileno())
+        # mid-shard-write hazard: the tmp file is complete but the shard
+        # is not published — a kill here must leave the previous
+        # checkpoint's manifest + files fully restorable
+        fault_point("ckpt.shard_write")
         os.replace(tmp, fname)
-        self.my_blocks = {}  # release the host snapshot
 
     def _write_guarded(self) -> None:
         try:
@@ -467,7 +479,13 @@ class _ShardedSave:
                 json.dump(self.manifest, f)
                 f.flush()
                 os.fsync(f.fileno())
+            # pre-commit hazard: every data file landed, manifest not yet
+            # replaced — a kill here must restore the OLD checkpoint
+            fault_point("ckpt.pre_commit")
             os.replace(mtmp, os.path.join(self.dirpath, MANIFEST))
+            # post-commit hazard: the new checkpoint is live but stale-
+            # token GC has not run — a kill here must restore the NEW one
+            fault_point("ckpt.post_commit")
 
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -632,6 +650,13 @@ def load_sharded(
             fpath = os.path.join(dirpath, fname)
             try:
                 npz = _RawNpz(fpath)
+            except OSError:
+                # transient read failure (cluster fs): bounded retry before
+                # falling back; np.load below re-raises hard failures
+                npz = retry_call(
+                    np.load, fpath, allow_pickle=False,
+                    what=f"checkpoint read {fname}",
+                )
             except Exception:
                 # NpzFile is lazy: only members actually accessed are read
                 npz = np.load(fpath, allow_pickle=False)
@@ -751,6 +776,65 @@ def peek_leaf(dirpath: str | os.PathLike, leaf_path: str):
     npz = np.load(os.path.join(dirpath, b["file"]), allow_pickle=False)
     arr = npz[b["key"]].view(np.dtype(meta["dtype"]))
     return arr.reshape(meta["shape"])
+
+
+def validate_checkpoint(dirpath: str | os.PathLike) -> list:
+    """Problems preventing ``dirpath`` from restoring; ``[]`` means valid.
+
+    The cheap completeness sweep behind fallback restore: manifest parses,
+    every referenced shard file exists and opens as a zip (a torn write
+    truncates the tail, which holds the zip central directory — so
+    truncation fails the open), carries the manifest's save token, and
+    contains every block key the manifest assigns to it. Does NOT read
+    array payloads — cost is one directory scan plus one tiny member read
+    per shard file, safe to run on every resume."""
+    import json
+
+    dirpath = os.fspath(dirpath)
+    mpath = os.path.join(dirpath, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        return [f"no {MANIFEST} (save died before its commit point)"]
+    except (OSError, ValueError) as e:
+        return [f"unreadable {MANIFEST}: {e}"]
+
+    token = manifest.get("token")
+    by_file: dict[str, set] = {}
+    for leaf, meta in manifest.get("leaves", {}).items():
+        for b in meta.get("blocks", []):
+            by_file.setdefault(b["file"], set()).add(b["key"])
+
+    problems = []
+    for fname, keys in sorted(by_file.items()):
+        fpath = os.path.join(dirpath, fname)
+        try:
+            with np.load(fpath, allow_pickle=False) as npz:
+                members = set(npz.files)
+                if token is not None:
+                    got = bytes(
+                        np.asarray(npz["__token__"]).tobytes()
+                    ).hex()
+                    if got != token:
+                        problems.append(
+                            f"{fname}: token {got} != manifest {token} "
+                            "(torn save)"
+                        )
+                        continue
+        except FileNotFoundError:
+            problems.append(f"{fname}: missing shard file")
+            continue
+        except Exception as e:
+            problems.append(f"{fname}: unreadable ({e})")
+            continue
+        lost = keys - members
+        if lost:
+            problems.append(
+                f"{fname}: {len(lost)} manifest block(s) absent "
+                f"(e.g. {sorted(lost)[0]!r})"
+            )
+    return problems
 
 
 STEP_CKPT_RE = re.compile(r"^step-(\d{8,})\.ckpt$")  # 8+: :08d overflows
@@ -938,26 +1022,53 @@ class Checkpointer:
                 ):
                     shutil.rmtree(p, ignore_errors=True)
 
-    def newest_restorable(self) -> Optional[str]:
-        """The restorable checkpoint with the highest saved
-        ``state/step``: ``latest.ckpt`` (suspend save) or a step-interval
-        checkpoint — a crash after interval saves but before any suspend
-        must resume from the newest interval save, not an older latest."""
+    def restorable_paths(self) -> list:
+        """Every VALIDATED restorable checkpoint, newest-first by saved
+        ``state/step`` (ties prefer ``latest.ckpt``). Candidates that fail
+        :func:`validate_checkpoint` — truncated shard, token mismatch,
+        missing blocks — are logged and skipped, so a run whose newest
+        save was torn by a crash falls back to the newest *complete* one
+        instead of refusing to start (the fallback-restore contract;
+        ANALYSIS.md "Failure model & recovery guarantees")."""
+        from pytorch_distributed_tpu.utils.logging import rank0_print
+
         candidates = [p for _s, p in self.step_checkpoints()]
         if self.has_latest():
             candidates.append(self.latest_path)
-        best, best_step = None, -1
-        for p in candidates:
+        ranked = []  # (step, tie_rank, path): later candidates win ties
+        for rank, p in enumerate(candidates):
             try:
                 if os.path.isdir(p):
                     s = int(np.asarray(peek_leaf(p, "state/step")))
                 else:  # legacy single-file latest: prefer only if alone
                     s = 0
-            except Exception:
+            except Exception as e:
+                rank0_print(
+                    f"checkpoint fallback: discarding {p} "
+                    f"(unreadable step leaf: {e})"
+                )
                 continue
-            if s >= best_step:  # ties → later candidate (latest.ckpt)
-                best, best_step = p, s
-        return best
+            ranked.append((s, rank, p))
+        out = []
+        for s, _rank, p in sorted(ranked, reverse=True):
+            if os.path.isdir(p):
+                problems = validate_checkpoint(p)
+                if problems:
+                    rank0_print(
+                        f"checkpoint fallback: discarding {p} at step {s}: "
+                        + "; ".join(problems)
+                    )
+                    continue
+            out.append(p)
+        return out
+
+    def newest_restorable(self) -> Optional[str]:
+        """The newest restorable checkpoint that passes validation:
+        ``latest.ckpt`` (suspend save) or a step-interval checkpoint,
+        whichever carries the highest ``state/step`` — scanning back past
+        corrupt candidates (see ``restorable_paths``)."""
+        paths = self.restorable_paths()
+        return paths[0] if paths else None
 
     def load_latest_sharded(self, template: Any, shardings: Any = None) -> Any:
         self.wait()

@@ -52,17 +52,38 @@ class SuspendWatcher:
         self.poll_interval = poll_interval
         self._event = threading.Event()
         self._last_poll = 0.0
+        # Chain, don't clobber: remember whatever handler was installed
+        # before us and call it after latching — a nested trainer, pytest,
+        # or a framework's own SIGTERM hook keeps working (and uninstall()
+        # can restore it).
+        self._prev_handlers: dict = {}
         if install_handlers:
             for sig in signals:
                 try:
-                    signal.signal(sig, self._on_signal)
+                    prev = signal.signal(sig, self._on_signal)
                 except (ValueError, OSError):  # non-main thread / restricted env
                     logger.debug("could not install handler for %s", sig)
+                else:
+                    self._prev_handlers[sig] = prev
 
     def _on_signal(self, signum, frame) -> None:
-        del frame
         logger.warning("received signal %d: suspend requested", signum)
         self._event.set()
+        prev = self._prev_handlers.get(signum)
+        if callable(prev):  # SIG_DFL/SIG_IGN/None are ints or None
+            prev(signum, frame)
+
+    def uninstall(self) -> None:
+        """Restore the handlers this watcher displaced (nested trainers,
+        tests). Only unwinds signals still pointing at us — a handler
+        someone installed on top stays."""
+        for sig, prev in list(self._prev_handlers.items()):
+            try:
+                if signal.getsignal(sig) == self._on_signal:
+                    signal.signal(sig, prev)
+            except (ValueError, OSError):
+                logger.debug("could not restore handler for %s", sig)
+            del self._prev_handlers[sig]
 
     def request_suspend(self) -> None:
         """Programmatic injection point (tests, embedding schedulers)."""

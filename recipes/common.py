@@ -59,6 +59,25 @@ def _base_parser(description: str, save_dir: str,
     p.add_argument("--save-dir", default=save_dir, help="checkpoint directory")
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None, help=batch_help)
+    # Resilience guards (resilience/; ANALYSIS.md "Failure model &
+    # recovery guarantees"). Example — survive NaN spikes and hangs on a
+    # long run:
+    #   python recipes/lm_pretrain.py --tiny --nan-guard --max-bad-steps 5 \
+    #       --watchdog-timeout 600 --save-every-n-steps 500
+    p.add_argument("--nan-guard", action="store_true",
+                   help="compile a finite gate into the train step: a "
+                        "non-finite loss/grad step keeps the pre-step "
+                        "params on device (no host sync) instead of "
+                        "poisoning the run")
+    p.add_argument("--max-bad-steps", type=int, default=0,
+                   help="with --nan-guard: after this many CONSECUTIVE "
+                        "skipped steps, roll back to the last good "
+                        "checkpoint (0 = skip-only, never roll back)")
+    p.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   help="seconds without a completed step before the "
+                        "watchdog dumps all-thread stacks and latches "
+                        "the suspend (checkpoint-and-yield) path "
+                        "(0 = off)")
     return p
 
 
@@ -143,6 +162,9 @@ def run(args, mesh, precision: str = "fp32") -> dict:
         precision=precision,
         save_dir=args.save_dir,
         num_workers=0 if args.tiny else 8,
+        nan_guard=args.nan_guard,
+        max_bad_steps=args.max_bad_steps,
+        watchdog_timeout_s=args.watchdog_timeout,
     )
     trainer = Trainer(
         model,

@@ -177,6 +177,9 @@ def main() -> None:
         pp_microbatches=args.pp_microbatches,
         save_every_n_steps=args.save_every_n_steps,
         keep_last_ckpts=args.keep_last_ckpts,
+        nan_guard=args.nan_guard,
+        max_bad_steps=args.max_bad_steps,
+        watchdog_timeout_s=args.watchdog_timeout,
     )
     trainer = LMTrainer(model_cfg, train_ds, val_ds, cfg, mesh=mesh,
                         suspend_watcher=SuspendWatcher())

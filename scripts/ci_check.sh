@@ -5,7 +5,12 @@
 # nor recorded with a reason in scripts/jaxlint_baseline.json — so NEW
 # hazards fail the build while the reviewed pre-existing ones don't.
 #
-# Usage: scripts/ci_check.sh [--lint-only]
+# Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke]
+#
+# --resilience-smoke: lint, then ONE crash-recovery cycle from the
+# kill-matrix (SIGKILL mid-shard-write → relaunch → assert resume) —
+# the cheap end-to-end proof that crash recovery still works, without
+# the full tier-1 suite or the whole @crash matrix.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -13,6 +18,14 @@ echo "== jaxlint =="
 JAX_PLATFORMS=cpu python scripts/jaxlint.py pytorch_distributed_tpu/
 
 if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+if [[ "${1:-}" == "--resilience-smoke" ]]; then
+    echo "== resilience smoke (kill mid-shard-write, relaunch, resume) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+        -m crash -k shard_write -p no:cacheprovider -p no:xdist \
+        -p no:randomly
     exit 0
 fi
 

@@ -1,0 +1,105 @@
+"""Direct unit tests for the suspend/checkpoint/yield protocol
+(utils/suspend.py): the flag-file, signal, and programmatic paths, plus
+the handler-chaining contract — none of which had dedicated tests before
+(the trainer tests only exercise injected watchers)."""
+
+import os
+import signal
+
+import pytest
+
+from pytorch_distributed_tpu.utils.suspend import (
+    NullSuspendWatcher,
+    SuspendWatcher,
+)
+
+
+def test_request_suspend_is_sticky():
+    w = SuspendWatcher(install_handlers=False)
+    assert not w.receive_suspend_command()
+    w.request_suspend()
+    assert w.receive_suspend_command()
+    assert w.receive_suspend_command()  # latched, stays set
+
+
+def test_flag_file_polling(tmp_path):
+    flag = tmp_path / "suspend.flag"
+    w = SuspendWatcher(flag_file=str(flag), poll_interval=0.0,
+                       install_handlers=False)
+    assert not w.receive_suspend_command()
+    flag.write_text("")
+    assert w.receive_suspend_command()
+    # sticky even after the flag file disappears
+    flag.unlink()
+    assert w.receive_suspend_command()
+
+
+def test_flag_file_from_env(tmp_path, monkeypatch):
+    flag = tmp_path / "env.flag"
+    monkeypatch.setenv("SUSPEND_FLAG_FILE", str(flag))
+    w = SuspendWatcher(poll_interval=0.0, install_handlers=False)
+    assert w.flag_file == str(flag)
+    flag.write_text("")
+    assert w.receive_suspend_command()
+
+
+def test_signal_delivery_latches():
+    w = SuspendWatcher(signals=(signal.SIGUSR1,))
+    try:
+        assert not w.receive_suspend_command()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert w.receive_suspend_command()
+    finally:
+        w.uninstall()
+
+
+def test_signal_handler_chains_previous():
+    """A previously-installed handler (a nested trainer, a framework
+    SIGTERM hook) must still fire — the watcher chains, not clobbers."""
+    calls = []
+
+    def mine(s, f):
+        calls.append(s)
+
+    prev = signal.signal(signal.SIGUSR1, mine)
+    try:
+        w = SuspendWatcher(signals=(signal.SIGUSR1,))
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert w.receive_suspend_command()
+            assert calls == [signal.SIGUSR1]  # the old handler ran too
+        finally:
+            w.uninstall()
+        # uninstall restored the previous handler verbatim
+        assert signal.getsignal(signal.SIGUSR1) is mine
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert len(calls) == 2
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_uninstall_leaves_foreign_handler():
+    """uninstall() only unwinds signals still pointing at the watcher — a
+    handler someone stacked on top stays installed."""
+    base = signal.getsignal(signal.SIGUSR1)
+    w = SuspendWatcher(signals=(signal.SIGUSR1,))
+    top = lambda s, f: None  # noqa: E731
+    signal.signal(signal.SIGUSR1, top)
+    try:
+        w.uninstall()
+        assert signal.getsignal(signal.SIGUSR1) is top
+    finally:
+        signal.signal(signal.SIGUSR1, base)
+
+
+def test_go_suspend_exits():
+    w = SuspendWatcher(install_handlers=False)
+    with pytest.raises(SystemExit) as e:
+        w.go_suspend(3)
+    assert e.value.code == 3
+
+
+def test_null_watcher_never_fires():
+    w = NullSuspendWatcher()
+    w.request_suspend()  # even explicit injection is ignored
+    assert not w.receive_suspend_command()

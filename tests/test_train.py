@@ -27,7 +27,11 @@ def tiny_model(**kw):
     )
 
 
-def make_trainer(tmp_path, devices8, watcher=None, epochs=2, precision="fp32", val_size=32):
+def make_trainer(tmp_path, devices8, watcher=None, epochs=2, precision="fp32",
+                 val_size=32, **cfg_over):
+    """Tiny ResNet trainer on the 8-device mesh; ``cfg_over`` forwards any
+    extra TrainerConfig field (used by test_resilience for the guard/
+    watchdog/interval-save knobs)."""
     train_ds = SyntheticImageClassification(size=128, image_size=16, num_classes=10)
     val_ds = SyntheticImageClassification(
         size=val_size, image_size=16, num_classes=10, seed=1
@@ -41,6 +45,7 @@ def make_trainer(tmp_path, devices8, watcher=None, epochs=2, precision="fp32", v
         log_every=0,
         num_workers=0,
         prefetch=1,
+        **cfg_over,
     )
     return Trainer(
         tiny_model(dtype=jnp.bfloat16 if precision == "bf16" else jnp.float32),
