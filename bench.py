@@ -47,6 +47,60 @@ import numpy as np
 
 BASELINE_IMG_S = 1_281_167 / 1786.7849  # single-A100 row, BASELINE.md
 
+# Remote-compile / tunnel failures that merit a bounded retry: one HTTP
+# 500 from the compile service erased round 5's LM headline number
+# (VERDICT r5 ``lm_error``). Markers are matched against str(e) because
+# the tunneled runtime surfaces them as opaque XlaRuntimeError text.
+_TRANSIENT_MARKERS = (
+    "Internal Server Error",
+    "HTTP/1.1 500",
+    " 500 ",
+    "Bad Gateway",
+    "Service Unavailable",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "Connection reset",
+    "Connection aborted",
+    "Socket closed",
+    "RST_STREAM",
+)
+
+
+def _is_transient(e: BaseException) -> bool:
+    msg = str(e)
+    if "RESOURCE_EXHAUSTED" in msg:
+        return False  # real OOM: handled by batch halving, never retried
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def retry_transient(fn, *args, what: str = "", retries: int = 2,
+                    base_delay: float = 2.0, max_delay: float = 10.0,
+                    **kwargs):
+    """Bounded retry for transient remote-compile/tunnel errors, on the
+    deterministic ``resilience.retry`` backoff schedule (seeded jitter —
+    reproducible sleeps). Non-transient failures propagate immediately;
+    after the last retry the original error propagates, so a section's
+    ``*_error`` reporting still works."""
+    import sys
+
+    from pytorch_distributed_tpu.resilience.retry import backoff_delays
+
+    delays = backoff_delays(retries=retries, base_delay=base_delay,
+                            max_delay=max_delay)
+    for attempt in range(len(delays) + 1):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            if attempt >= len(delays) or not _is_transient(e):
+                raise
+            print(
+                f"bench: {what or getattr(fn, '__name__', 'call')} hit a "
+                f"transient error ({str(e)[:160]}); retry "
+                f"{attempt + 1}/{len(delays)} in {delays[attempt]:.1f}s",
+                file=sys.stderr,
+            )
+            time.sleep(delays[attempt])
+
 
 def measure_roundtrip_s(n: int = 3) -> float:
     """Host↔device round-trip cost of one scalar value fetch.
@@ -234,7 +288,9 @@ def main() -> None:
     fused = os.environ.get("BENCH_FUSED", "1") == "1" and not tiny
     while True:
         try:
-            img_s, step_s, duty = run(batch_size, tiny, fused=fused)
+            img_s, step_s, duty = retry_transient(
+                run, batch_size, tiny, fused=fused, what="headline resnet"
+            )
             break
         except Exception as e:  # XlaRuntimeError isn't a stable import path
             if "RESOURCE_EXHAUSTED" in str(e) and batch_size > 8:
@@ -282,12 +338,16 @@ def main() -> None:
             record["ckpt_bench_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_ATTN", "1") == "1":
         try:
-            record.update(bench_flash_attention())
+            record.update(
+                retry_transient(bench_flash_attention, what="flash bench")
+            )
         except Exception as e:
             record["flash_attn_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_LM", "1") == "1":
         try:
-            record.update(bench_lm_training())
+            # bounded retry: round 5 lost this exact headline to ONE
+            # transient remote-compile HTTP 500 (VERDICT r5 lm_error)
+            record.update(retry_transient(bench_lm_training, what="lm bench"))
         except Exception as e:
             record["lm_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_SERVING", "1") == "1":
@@ -298,12 +358,21 @@ def main() -> None:
                 os.path.dirname(os.path.abspath(__file__)), "scripts"))
             import bench_serving
 
-            r = bench_serving.measure(slots=32, max_new=64)
-            r.pop("device", None)
-            record.update(r)
-            record.update(bench_serving.measure_admission_stall(
-                slots=32, tick_ms=r["serving_decode_ms_per_token"]
-            ))
+            def _serving():
+                r = bench_serving.measure(slots=32, max_new=64)
+                r.pop("device", None)
+                # admission-heavy A/B: the dense layout's per-admission
+                # stall vs the paged engine's, both folded into the
+                # equilibrium short-output throughput model
+                r.update(bench_serving.measure_admission_stall(
+                    slots=32, tick_ms=r["serving_decode_ms_per_token"]
+                ))
+                r.update(bench_serving.measure_paged_admission(
+                    slots=32, tick_ms=r["serving_decode_ms_per_token"]
+                ))
+                return r
+
+            record.update(retry_transient(_serving, what="serving bench"))
         except Exception as e:
             record["serving_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_FP32", "1") == "1":

@@ -316,7 +316,9 @@ def _tp_rules(config):
                   for part in spec)))
         for pat, spec in TRANSFORMER_TP_RULES
     ]
-    if getattr(config, "vocab_parallel", False) and config.tp_size > 1:
+    if getattr(config, "uses_vocab_parallel", lambda: False)():
+        # THE shared predicate (TransformerConfig.uses_vocab_parallel) —
+        # same condition the model's head branch and train/lm.py use
         from pytorch_distributed_tpu.train.lm import _vocab_rules
 
         rules += [(pat, P(*spec)) for pat, spec in _vocab_rules(config)]
@@ -488,27 +490,44 @@ def _generate_ragged_tp_compiled(mesh, config, max_new_tokens, temperature,
 
 class ContinuousBatcher:
     """Continuous batching over ``n_slots`` decode lanes (host-side
-    scheduler around two compiled programs).
+    scheduler around compiled programs).
 
-    ``submit`` prefills ONE request into a free slot (its own compiled
-    ragged prefill at batch 1, row-inserted into the shared cache);
-    ``step`` advances ALL active slots one token and retires slots that
-    hit their budget. Requests therefore enter and leave at token
-    boundaries while others keep decoding — continuous batching without
-    a serving system around it. Static shapes: one prefill program per
-    padded prompt length bucket (lengths round up to ``prefill_bucket``),
-    one decode program total.
+    ``submit`` prefills ONE request into a free slot; ``step`` advances
+    ALL active slots one token and retires slots that hit their budget.
+    Requests therefore enter and leave at token boundaries while others
+    keep decoding.
+
+    Round 6: the default cache is the block-pooled PAGED layout
+    (``cache_layout="paged"``, ``pytorch_distributed_tpu.serving``) —
+    admission allocates fresh KV blocks and writes O(prompt), never
+    copying resident requests' KV; the round-4 dense layout (one
+    ``max_seq_len`` KV row per slot, admission writing the full row)
+    survives as ``cache_layout="dense"`` for parity tests and A/B
+    benches. Both layouts produce token-identical greedy streams
+    (tests/test_paged_serving.py). ``prefill_bucket`` is the prompt
+    padding granularity in both: the dense prefill pads prompts to it;
+    the paged engine uses it as the chunk length. For queueing instead
+    of submit-time failure (and chunked prefill interleaved with
+    decode), use ``serving.Scheduler``.
     """
 
     def __init__(self, config: TransformerConfig, params, n_slots: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  prefill_bucket: int = 128, seed: int = 0,
-                 eos_id: Optional[int] = None, mesh=None):
+                 eos_id: Optional[int] = None, mesh=None,
+                 cache_layout: str = "paged", block_len: int = 16,
+                 n_blocks: Optional[int] = None):
         _validate_serving_config(config, mesh)
         _validate_sampling(config, temperature, top_k)
         if eos_id is not None and not 0 <= eos_id < config.vocab_size:
             raise ValueError(
                 f"eos_id {eos_id} outside [0, vocab_size={config.vocab_size})"
+            )
+        if cache_layout not in ("paged", "dense"):
+            raise ValueError(
+                f"cache_layout {cache_layout!r} must be 'paged' (block-"
+                "pooled KV, O(prompt) admission) or 'dense' (one "
+                "max_seq_len row per slot, the r4 layout)"
             )
         self.eos_id = eos_id
         self.config = config
@@ -516,6 +535,22 @@ class ContinuousBatcher:
         self.temperature = temperature
         self.top_k = top_k
         self.prefill_bucket = prefill_bucket
+        self.cache_layout = cache_layout
+        if cache_layout == "paged":
+            from pytorch_distributed_tpu.serving.engine import PagedEngine
+
+            self.engine = PagedEngine(
+                config, params, n_slots, n_blocks=n_blocks,
+                block_len=block_len, prefill_chunk=prefill_bucket,
+                temperature=temperature, top_k=top_k, mesh=mesh,
+            )
+            self.mesh = mesh
+            self.params = self.engine.params
+            self.positions = np.zeros(n_slots, np.int32)
+            self.remaining = np.zeros(n_slots, np.int32)
+            self._rng = jax.random.key(seed)
+            return
+        self.engine = None
         tp = config.model_axis is not None
         # Cache shapes are GLOBAL (full head count — from a collective-free
         # twin config); under TP, placement shards the head dim over the
@@ -607,17 +642,37 @@ class ContinuousBatcher:
             self._submit_one = jax.jit(_submit_body, donate_argnums=(3, 4))
             self._step_fn = jax.jit(_step_body, donate_argnums=(1, 2))
 
+    @property
+    def cache(self):
+        """The KV cache pytree: the block POOL under the paged layout
+        (leaves ``[n_blocks, block_len, H_kv, D]``), per-slot dense rows
+        (``[n_slots, max_seq_len, H_kv, D]``) under the dense one."""
+        return self.engine.cache if self.engine is not None else self._cache
+
+    @cache.setter
+    def cache(self, value):
+        if self.engine is not None:
+            self.engine.cache = value
+        else:
+            self._cache = value
+
+    @property
+    def logits(self):
+        return (
+            self.engine.logits if self.engine is not None else self._logits
+        )
+
+    @logits.setter
+    def logits(self, value):
+        if self.engine is not None:
+            self.engine.logits = value
+        else:
+            self._logits = value
+
     def free_slots(self):
         return [i for i in range(self.n_slots) if self.remaining[i] == 0]
 
-    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
-        """Admit one request ([L] int32); returns its slot. Raises if no
-        slot is free or the budget exceeds the cache."""
-        free = self.free_slots()
-        if not free:
-            raise RuntimeError("no free decode slot; call step() to drain")
-        slot = free[0]
-        l = len(prompt)
+    def _validate_submit(self, l: int, max_new_tokens: int) -> None:
         if l < 1:
             raise ValueError("prompt must contain at least one token")
         pad = -l % self.prefill_bucket
@@ -634,6 +689,50 @@ class ContinuousBatcher:
                 f"prompt ({l}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_seq_len {self.config.max_seq_len}"
             )
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
+        """Admit one request ([L] int32); returns its slot. Raises if no
+        slot is free or the budget exceeds the cache.
+
+        Paged layout: admission allocates the request's block chain and
+        prefills O(prompt) — chunk-program writes into FRESH blocks; no
+        resident request's KV is copied (the r5 admission tax is gone:
+        the dense layout wrote a full max_seq_len row here). With the
+        default pool size a free slot always implies free blocks; an
+        explicitly undersized ``n_blocks`` can raise on pool exhaustion
+        — use ``serving.Scheduler`` when you want queueing instead."""
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free decode slot; call step() to drain")
+        slot = free[0]
+        l = len(prompt)
+        self._validate_submit(l, max_new_tokens)
+        if self.engine is not None:
+            from pytorch_distributed_tpu.serving.engine import ChunkJob
+
+            if not self.engine.admit(slot, l, max_new_tokens):
+                raise RuntimeError(
+                    "KV block pool exhausted (custom n_blocks below slot "
+                    "capacity); retire requests, raise n_blocks, or use "
+                    "serving.Scheduler to queue admissions"
+                )
+            c = self.engine.chunk
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            for start in range(0, l, c):
+                seg = prompt[start:start + c]
+                tokens = np.zeros((c,), np.int32)
+                tokens[:len(seg)] = seg
+                is_last = start + c >= l
+                # chunks run in order: chunk n+1 attends to chunk n's
+                # pool writes
+                self.engine.run_chunks([ChunkJob(
+                    slot=slot, tokens=tokens, start=start, is_last=is_last,
+                    last_idx=(l - 1 - start) if is_last else 0,
+                )])
+            self.positions[slot] = l
+            self.remaining[slot] = max_new_tokens
+            return slot
+        pad = -l % self.prefill_bucket
         padded = np.zeros((1, l + pad), np.int32)
         padded[0, :l] = prompt
         self.cache, self.logits = self._submit_one(
@@ -653,14 +752,19 @@ class ContinuousBatcher:
         if not active_np.any():
             return []
         self._rng, sub = jax.random.split(self._rng)
-        cache, logits, positions, tokens = self._step_fn(
-            self.params, self.cache, self.logits,
-            jnp.asarray(self.positions), jnp.asarray(active_np), sub,
-        )
-        self.cache, self.logits = cache, logits
-        self.positions = np.array(positions)  # owned, writable copy
+        if self.engine is not None:
+            toks, self.positions = self.engine.decode(
+                self.positions, active_np, sub
+            )
+        else:
+            cache, logits, positions, tokens = self._step_fn(
+                self.params, self.cache, self.logits,
+                jnp.asarray(self.positions), jnp.asarray(active_np), sub,
+            )
+            self.cache, self.logits = cache, logits
+            self.positions = np.array(positions)  # owned, writable copy
+            toks = np.asarray(tokens)
         out = []
-        toks = np.asarray(tokens)
         for slot in np.nonzero(active_np)[0]:
             token = int(toks[slot])
             out.append((int(slot), token))
@@ -668,4 +772,8 @@ class ContinuousBatcher:
                 self.remaining[slot] = 0  # early retirement
             else:
                 self.remaining[slot] -= 1
+            if self.engine is not None and self.remaining[slot] == 0:
+                # retirement returns the block chain to the pool (LIFO
+                # reuse) and routes the dead lane's writes to trash
+                self.engine.release(int(slot))
         return out

@@ -182,6 +182,20 @@ class TransformerConfig:
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(f"dropout must be in [0, 1), got {self.dropout}")
 
+    def uses_vocab_parallel(self) -> bool:
+        """THE vocab-parallel predicate — the one place the condition
+        lives. The model's head/embedding branch, the TP placement rules
+        (``train/lm.py``), and the serving rule builder
+        (``models/generate.py``) all call this, so they cannot diverge on
+        edge cases (e.g. ``model_axis`` set with ``tp_size == 1``, where
+        sharding the vocab dim would be vacuous but the collective branch
+        is not free)."""
+        return (
+            self.vocab_parallel
+            and self.model_axis is not None
+            and self.tp_size > 1
+        )
+
 
 def _rope_rotate(x, positions, theta: float):
     """Rotary embedding on ``x`` [B, L, H, D] at absolute ``positions``
@@ -204,7 +218,8 @@ class Attention(nn.Module):
     prefill: bool = False
 
     @nn.compact
-    def __call__(self, x, position_offset, positions=None):
+    def __call__(self, x, position_offset, positions=None,
+                 block_tables=None):
         cfg = self.config
         b, l, e = x.shape
         head_dim = e // cfg.num_heads
@@ -250,6 +265,67 @@ class Attention(nn.Module):
             rpos = positions[None] if positions.ndim == 1 else positions
             q = _rope_rotate(q, rpos, cfg.rope_theta)
             k = _rope_rotate(k, rpos, cfg.rope_theta)
+
+        if block_tables is not None:
+            # Paged serving (serving/): the cache is a block POOL
+            # [n_blocks, block_len, H_kv, D] shared by every request, and
+            # this request's logical positions map to pool blocks through
+            # its block-table row. One path serves BOTH chunked prefill
+            # (l == chunk) and decode (l == 1): write the chunk at its
+            # absolute positions, then attend against the gathered chain —
+            # which includes the chunk just written, so intra-chunk
+            # causality falls out of the same mask as cross-chunk.
+            # ``position_offset`` stays the single source of position
+            # truth: the block/offset write indices, the attention mask,
+            # and the positional embedding all derive from the same [B]
+            # start vector.
+            if not (self.decode or self.prefill):
+                raise ValueError(
+                    "block_tables= is the paged SERVING cache layout; it "
+                    "requires decode or prefill mode"
+                )
+            from pytorch_distributed_tpu.ops.attention import paged_attention
+
+            def _need_pool(*_a):
+                raise ValueError(
+                    "paged attention needs the pool cache passed in "
+                    "(apply with {'cache': serving.kv_pool.init_paged_"
+                    "cache(...)}); there is no in-module init for it"
+                )
+
+            kv_heads = k.shape[2]
+            ck = self.variable("cache", "key", _need_pool)
+            cv = self.variable("cache", "value", _need_pool)
+            block_len = ck.value.shape[1]
+            pos = jnp.asarray(position_offset, jnp.int32)
+            if pos.ndim != 1:
+                raise ValueError(
+                    "paged mode takes a [B] position_offset vector (each "
+                    "request's write start), got a scalar"
+                )
+            p = pos[:, None] + jnp.arange(l)  # [B, l] absolute positions
+            blk = jnp.take_along_axis(block_tables, p // block_len, axis=1)
+            off = p % block_len
+            # Scatter the chunk into the pool. Index pairs are unique per
+            # request (each owns its blocks); the engine routes inactive
+            # slots' writes to the trash block, where duplicate hits are
+            # harmless garbage.
+            ck.value = ck.value.at[blk.reshape(-1), off.reshape(-1)].set(
+                k.astype(cfg.dtype).reshape(b * l, kv_heads, head_dim)
+            )
+            cv.value = cv.value.at[blk.reshape(-1), off.reshape(-1)].set(
+                v.astype(cfg.dtype).reshape(b * l, kv_heads, head_dim)
+            )
+            out = paged_attention(q, ck.value, cv.value, block_tables, p)
+            out = nn.DenseGeneral(
+                e, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+                name="proj",
+            )(out)
+            if cfg.model_axis:
+                from pytorch_distributed_tpu.parallel.tensor import tp_reduce
+
+                out = tp_reduce(out, cfg.model_axis)
+            return out
 
         if self.decode or self.prefill:
             # KV cache. ``position_offset`` is the single source of
@@ -446,13 +522,14 @@ class Block(nn.Module):
     prefill: bool = False
 
     @nn.compact
-    def __call__(self, x, position_offset, positions=None):
+    def __call__(self, x, position_offset, positions=None,
+                 block_tables=None):
         cfg = self.config
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + Attention(
             cfg, deterministic=self.deterministic, decode=self.decode,
             prefill=self.prefill, name="attn",
-        )(h, position_offset, positions)
+        )(h, position_offset, positions, block_tables)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.use_moe:
             from pytorch_distributed_tpu.models.moe import MoEMLP
@@ -505,14 +582,17 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, position_offset: jax.Array | int = 0,
                  train: bool = True, decode: bool = False,
                  prefill: bool = False, positions: jax.Array | None = None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 block_tables: jax.Array | None = None):
         cfg = self.config
         # Dropout is active only when train=True AND an rng is provided
         # (apply(..., rngs={"dropout": key}) — train/lm.py derives the key
         # from (seed, step, shard coords) so resumed runs are bit-identical).
         inference = decode or prefill
         deterministic = not (train and cfg.dropout > 0.0) or inference
-        vp = cfg.vocab_parallel and cfg.model_axis is not None
+        vp = cfg.uses_vocab_parallel()  # THE shared predicate — train/lm.py
+        # and models/generate.py consult the same method, so the head/
+        # embedding branch and the placement rules cannot diverge
         if vp:
             # Vocab-parallel embedding: each shard owns vocab rows
             # [r*V/tp, (r+1)*V/tp); out-of-range tokens look up a clipped
@@ -554,22 +634,25 @@ class TransformerLM(nn.Module):
                 "layout='zigzag')."
             )
         off = jnp.asarray(position_offset, jnp.int32)
-        if off.ndim == 1 and (not decode or tokens.shape[1] != 1):
+        if off.ndim == 1 and not (
+            (decode and tokens.shape[1] == 1) or block_tables is not None
+        ):
             raise ValueError(
                 "a [B] position_offset vector is the ragged DECODE "
-                "convention (one token per request); prefill/training "
-                "use a scalar offset or positions="
+                "convention (one token per request) or the paged serving "
+                "convention (block_tables= set); prefill/training use a "
+                "scalar offset or positions="
             )
         # ONE resolution of per-token absolute positions, feeding BOTH
         # the learned wpe lookup and (passed down to every block) the
         # rope rotation — the two can never disagree. Shapes: [L] shared,
-        # [B, L] per-request, or [B, 1] ragged decode.
+        # [B, L] per-request, [B, 1] ragged decode, or [B, chunk] paged
+        # chunk prefill (each request's chunk at its own start).
         if positions is not None:
             pos = positions
         elif off.ndim == 1:
-            # per-request decode positions [B] (ragged serving): one
-            # token per row, each at its own absolute position
-            pos = off[:, None]
+            # per-request start positions [B] (ragged/paged serving)
+            pos = off[:, None] + jnp.arange(tokens.shape[1])
         else:
             pos = off + jnp.arange(tokens.shape[1])
         if cfg.pos_embedding == "learned":
@@ -584,7 +667,7 @@ class TransformerLM(nn.Module):
             x = Block(
                 cfg, use_moe=use_moe, deterministic=deterministic,
                 decode=decode, prefill=prefill, name=f"block{i}",
-            )(x, position_offset, pos)
+            )(x, position_offset, pos, block_tables)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         head = nn.Dense(
             cfg.vocab_size // cfg.tp_size if vp else cfg.vocab_size,

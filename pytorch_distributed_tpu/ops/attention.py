@@ -16,7 +16,10 @@ TPU-first:
 - every kernel takes absolute position offsets for Q and KV, so the same
   code computes a causal mask inside one device's shard or across ring
   steps where the KV block came from another device
-  (``parallel/sequence.py``).
+  (``parallel/sequence.py``);
+- ``paged_attention`` is the serving engine's read path: decode/chunk
+  queries against a block-pooled KV cache through a block table
+  (``serving/kv_pool.py``), dense ``jnp.take``-over-blocks gather.
 
 Shapes follow the JAX convention: ``[batch, length, heads, head_dim]``.
 """
@@ -141,6 +144,94 @@ def dense_attention(
     return jnp.einsum(
         "bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)  # jaxlint: disable=precision-cast -- fp32 PV matmul matches blockwise/ring accumulator dtype
     ).astype(q.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    q_positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    gather_impl: str = "dense",
+) -> jax.Array:
+    """Decode/chunk-prefill attention against a block-pooled KV cache.
+
+    The serving engine's cache is a fixed pool of KV blocks
+    (``serving.kv_pool``); each request owns a chain of blocks recorded in
+    its block-table row, so admission never copies resident requests' KV.
+    This op is the read side: gather each request's blocks back into a
+    logical [L, H_kv, D] sequence and attend causally at absolute
+    positions.
+
+    Args:
+      q: ``[B, C, H, D]`` queries — C == 1 for a decode tick, C == chunk
+        length for chunked prefill (both use this one op, so the two can
+        never diverge on masking).
+      k_pool, v_pool: ``[n_blocks, block_len, H_kv, D]`` pooled cache.
+        ``H_kv < H`` is the GQA layout; query head h reads narrow head
+        ``h // (H // H_kv)`` via a grouped einsum — the widened K/V never
+        materializes (same trick as the dense decode path).
+      block_tables: ``[B, W]`` int32 — request b's logical positions
+        ``[w*block_len, (w+1)*block_len)`` live in pool block
+        ``block_tables[b, w]``. Entries past the request's allocation
+        should point at the engine's trash block; they are masked out
+        (their logical positions exceed every query position).
+      q_positions: ``[B, C]`` int32 absolute positions of the queries;
+        key position j is visible to query i iff ``j <= q_positions[i]``.
+      gather_impl: ``"dense"`` — one ``jnp.take`` over the block dim (the
+        shipped spelling: PERF_NOTES §6's lesson is to change the math XLA
+        sees, not excise ops into custom calls). ``"pallas"`` is reserved
+        for a fused gather-attend kernel and currently raises — the flag
+        exists so call sites are already plumbed when the kernel lands.
+
+    Returns ``[B, C, H, D]`` in q's dtype. Softmax statistics in fp32.
+    """
+    if gather_impl == "pallas":
+        raise NotImplementedError(
+            "gather_impl='pallas' (fused block-gather attention kernel) is "
+            "reserved but not implemented; use the default 'dense' spelling"
+        )
+    if gather_impl != "dense":
+        raise ValueError(
+            f"gather_impl {gather_impl!r} must be 'dense' (or the reserved "
+            "'pallas')"
+        )
+    b, c, h, d = q.shape
+    n_blocks, block_len, h_kv, _ = k_pool.shape
+    if h % h_kv:
+        raise ValueError(
+            f"query heads {h} not a multiple of pool KV heads {h_kv}"
+        )
+    group = h // h_kv
+    w = block_tables.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    # Gather the per-request logical KV sequences: [B, W*block_len, H_kv, D].
+    kg = jnp.take(k_pool, block_tables, axis=0).reshape(
+        b, w * block_len, h_kv, d
+    )
+    vg = jnp.take(v_pool, block_tables, axis=0).reshape(
+        b, w * block_len, h_kv, d
+    )
+    # Grouped logits directly against the narrow heads (query head
+    # h = h_kv_idx*group + g), fp32 statistics like every other path.
+    qg = (q.astype(jnp.float32) * scale).reshape(b, c, h_kv, group, d)  # jaxlint: disable=precision-cast -- fp32 softmax statistics by kernel contract
+    s = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, kg.astype(jnp.float32)  # jaxlint: disable=precision-cast -- fp32 softmax statistics by kernel contract
+    )  # [B, H_kv, G, C, W*bl]
+    k_pos = jnp.arange(w * block_len)
+    allowed = (
+        k_pos[None, None, None, None, :]
+        <= q_positions[:, None, None, :, None]
+    )
+    s = jnp.where(allowed, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = p * allowed  # fully-masked rows → zeros, matching dense/blockwise
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", p, vg.astype(jnp.float32)  # jaxlint: disable=precision-cast -- fp32 PV accumulation matches the other attention paths
+    )
+    return out.reshape(b, c, h, d).astype(q.dtype)
 
 
 def blockwise_attention(

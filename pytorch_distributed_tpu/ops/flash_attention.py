@@ -469,12 +469,13 @@ def _flash_bwd(q3, k3, v3, o3, lse3, do3, scale, causal, dq_blocks,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _flash(q, k, v, scale, causal, block_q, block_k, kv_len, interpret,
-           dq_blocks=None, dkv_blocks=None, bwd_impl="split"):
+           dq_blocks=None, dkv_blocks=None, bwd_impl="split",
+           partials_f32=False):
     out, _ = _flash_vjp_fwd(
         q, k, v, scale, causal, block_q, block_k, kv_len, interpret,
-        dq_blocks, dkv_blocks, bwd_impl,
+        dq_blocks, dkv_blocks, bwd_impl, partials_f32,
     )
     return out
 
@@ -491,7 +492,7 @@ def _from3(x3, b, h):
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, kv_len,
                    interpret, dq_blocks=None, dkv_blocks=None,
-                   bwd_impl="split"):
+                   bwd_impl="split", partials_f32=False):
     b, lq, h, d = q.shape
     o3, lse3 = _flash_fwd(
         _to3(q), _to3(k), _to3(v), scale, causal, block_q, block_k, kv_len,
@@ -501,7 +502,7 @@ def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, kv_len,
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, kv_len, interpret,
-                   dq_blocks, dkv_blocks, bwd_impl, res, g):
+                   dq_blocks, dkv_blocks, bwd_impl, partials_f32, res, g):
     q, k, v, o3, lse3 = res
     b, lq, h, d = q.shape
     # The backward tiles independently of the forward; flash_attention
@@ -513,6 +514,7 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, kv_len, interpret,
         dq3, dk3, dv3 = _flash_bwd_fused(
             _to3(q), _to3(k), _to3(v), o3, lse3, _to3(g.astype(q.dtype)),
             scale, causal, dq_blocks, kv_len, interpret,
+            partials_f32=partials_f32,
         )
     else:
         dq3, dk3, dv3 = _flash_bwd(
@@ -538,6 +540,7 @@ def flash_attention(
     bwd_block_k: Optional[int] = None,
     interpret: bool | None = None,
     bwd_impl: str = "fused",
+    partials_f32: bool = False,
 ) -> jax.Array:
     """FlashAttention: ``softmax(QKᵀ·scale)V`` tiled through VMEM.
 
@@ -557,7 +560,17 @@ def flash_attention(
         kernel with HBM dQ partials, 61-118 TFLOP/s fwdbwd at 1k-16k vs
         the split kernels' 48-97 (BENCH_ATTENTION.md r5); "split" — the
         r4 two-kernel decomposition (still used per ring visit by
-        ops/ring_flash.py).
+        ops/ring_flash.py). PRECISION NOTE for the fused path: each
+        (q, kv) grid step emits a partial dQ block at the INPUT dtype, so
+        for bf16 models every partial rounds to bf16 before the fp32
+        cross-partial sum — a deliberate precision change from the split
+        kernels' pure-fp32 dQ accumulation, measured faster at every
+        length and loss-neutral in training (BENCH_ATTENTION.md r5).
+      partials_f32: keep the fused backward's dQ partials in fp32
+        (doubles their HBM traffic; bitwise matches the split kernels'
+        dQ accumulation dtype). Ignored by bwd_impl="split", which is
+        always fp32. Exposed for precision sweeps and debugging
+        suspected dQ rounding (ADVICE r5 #2).
 
     Default block sizes come from an on-chip sweep (v5e, causal, D=128,
     scripts/bench_attention.py --sweep): (512, 1024) wins at every length
@@ -648,8 +661,8 @@ def flash_attention(
         padk = lambda x: jnp.pad(x, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
         out = _flash(
             padq(q), padk(k), padk(v), scale, causal, block_q, block_k, lk,
-            interpret, dq_blocks, dkv_blocks, bwd_impl,
+            interpret, dq_blocks, dkv_blocks, bwd_impl, partials_f32,
         )
         return out[:, :lq]
     return _flash(q, k, v, scale, causal, block_q, block_k, lk, interpret,
-                  dq_blocks, dkv_blocks, bwd_impl)
+                  dq_blocks, dkv_blocks, bwd_impl, partials_f32)

@@ -154,7 +154,13 @@ def _fused_ce_bwd(block_n, cdt, vocab_axis, res, g):
         jnp.zeros(kernel.shape, jnp.float32),
         (xb, lb, wb, lse_b),
     )
-    d_weights = ((lse - z) * g).astype(jnp.float32)
+    # Cotangent dtypes must match the PRIMAL dtypes: weights arrive at
+    # whatever dtype the caller passed (the fwd casts a fp32 COPY for the
+    # math), and returning a hardcoded fp32 cotangent for e.g. bf16
+    # weights fails deep inside the vjp trace with an opaque dtype
+    # mismatch (ADVICE r5 #4). The per-token loss (lse - z) stays fp32
+    # until this final cast.
+    d_weights = ((lse - z) * g).astype(weights.dtype)
     return (
         dx.reshape(n, e),
         dw.astype(kernel.dtype),

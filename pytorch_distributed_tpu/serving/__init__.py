@@ -1,0 +1,46 @@
+"""Paged-KV serving engine (vLLM/PagedAttention + Orca continuous
+batching, applied to the TP-capable JAX serving path).
+
+The round-4/5 serving layer (``models.generate.ContinuousBatcher``) kept
+one dense ``max_seq_len`` KV row per decode slot; every admission wrote a
+full row — O(per-slot cache), the measured ~30% equilibrium throughput
+tax at short outputs (BENCH_LM.md r5). This package replaces the dense
+rows with a fixed pool of KV *blocks* plus per-slot block tables:
+
+- ``kv_pool``   — the block allocator (free list, per-request chains,
+  deterministic OOM → the caller queues instead of crashing) and the
+  pooled cache pytree with its TP placement;
+- ``engine``    — the compiled programs: k-batched chunk prefill (one
+  insert program admits several requests) and the shared decode tick,
+  both donating the pool so updates are in place;
+- ``scheduler`` — the continuous scheduler: FIFO admission queue,
+  chunked prefill interleaved with decode, slot accounting, and exact
+  host-side metrics (occupancy, padding waste, admission latency, queue
+  depth, tokens/s).
+
+``models.generate.ContinuousBatcher`` delegates here by default
+(``cache_layout="paged"``); the dense layout survives as
+``cache_layout="dense"`` for parity tests. ANALYSIS.md "Serving engine"
+documents the block layout and the admission path.
+"""
+
+from pytorch_distributed_tpu.serving.kv_pool import (
+    TRASH_BLOCK,
+    BlockAllocator,
+    blocks_needed,
+    init_paged_cache,
+    paged_cache_specs,
+)
+from pytorch_distributed_tpu.serving.engine import PagedEngine
+from pytorch_distributed_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = [
+    "TRASH_BLOCK",
+    "BlockAllocator",
+    "blocks_needed",
+    "init_paged_cache",
+    "paged_cache_specs",
+    "PagedEngine",
+    "Request",
+    "Scheduler",
+]

@@ -1,0 +1,318 @@
+"""The paged serving engine: compiled chunk-prefill + decode programs.
+
+Two program families over one block-pooled KV cache
+(``serving.kv_pool``), both donating the pool and the logits buffer so
+every update is in place:
+
+- **chunk prefill** (``run_chunks``): processes one fixed-length chunk
+  of up to ``k`` requests' prompts in ONE program — the batched
+  admission insert. Each job carries its own start position and a slice
+  of its block table, so the program's cost is O(k · chunk · prompt
+  bucket): independent of the pool size, the slot count, and
+  ``max_seq_len`` — the whole point of the paged layout (the dense
+  layout's admission wrote a full ``max_seq_len`` row; see ISSUE r6 /
+  ANALYSIS.md "Serving engine"). Programs are cached per (padded k,
+  table-slice width) — both padded to powers of two to bound compile
+  count.
+- **decode** (``decode``): one token for every slot, exactly the dense
+  ``_step_body`` shape but attending through the block table
+  (``ops.attention.paged_attention``). Inactive lanes' writes are routed
+  to the trash block by host-side table masking, so recycled blocks can
+  never be corrupted by a dead lane.
+
+Tensor parallelism reuses the dense serving path's machinery: params
+placed by ``models.generate._tp_rules``, the pool head-sharded by
+``kv_pool.paged_cache_specs``, programs wrapped in ``shard_map`` over the
+model axis with replicated logits/sampling (token streams identical on
+every shard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.serving.kv_pool import (
+    TRASH_BLOCK,
+    BlockAllocator,
+    blocks_needed,
+    init_paged_cache,
+    paged_cache_specs,
+)
+
+
+def _pow2_bucket(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class ChunkJob(NamedTuple):
+    """One prompt chunk to prefill: ``tokens`` is the chunk (padded to
+    the engine's chunk length with zeros), ``start`` its absolute
+    position, ``last_idx`` the in-chunk index of the prompt's final real
+    token (meaningful only when ``is_last``)."""
+
+    slot: int
+    tokens: np.ndarray  # [chunk] int32
+    start: int
+    is_last: bool
+    last_idx: int
+
+
+class PagedEngine:
+    """Device state + compiled programs for paged continuous batching.
+
+    The engine owns the pool cache, the logits buffer, the block
+    allocator, and the block tables; it does NOT schedule — the caller
+    (``serving.scheduler.Scheduler`` or the rewired
+    ``models.generate.ContinuousBatcher``) decides what to admit and
+    when to decode, and owns per-slot positions/budgets.
+    """
+
+    def __init__(self, config, params, n_slots: int, *,
+                 n_blocks: Optional[int] = None, block_len: int = 16,
+                 prefill_chunk: int = 128, temperature: float = 0.0,
+                 top_k: Optional[int] = None, mesh=None):
+        from pytorch_distributed_tpu.models.generate import (
+            _validate_sampling,
+            _validate_serving_config,
+        )
+
+        _validate_serving_config(config, mesh)
+        _validate_sampling(config, temperature, top_k)
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.config = config
+        self.n_slots = n_slots
+        self.block_len = block_len
+        self.chunk = prefill_chunk
+        self.temperature = temperature
+        self.top_k = top_k
+        # Per-slot table width: enough blocks for a full-capacity request.
+        self.table_width = -(-config.max_seq_len // block_len)
+        if n_blocks is None:
+            # Capacity parity with the dense layout (every slot can hold
+            # max_seq_len), plus the trash block.
+            n_blocks = n_slots * self.table_width + 1
+        self.allocator = BlockAllocator(n_blocks)
+        self.tables = np.full((n_slots, self.table_width), TRASH_BLOCK,
+                              np.int32)
+
+        tp = config.model_axis is not None
+        init_cfg = (
+            dataclasses.replace(config, model_axis=None, tp_size=1)
+            if tp else config
+        )
+        self.cache = init_paged_cache(init_cfg, params, n_blocks, block_len)
+        self.logits = jnp.zeros((n_slots, config.vocab_size), jnp.float32)
+
+        self._chunk_fns: Dict[Tuple[int, int], callable] = {}
+        self._decode_fn = None
+        if tp:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from pytorch_distributed_tpu.models.generate import _tp_rules
+            from pytorch_distributed_tpu.parallel.tensor import (
+                match_partition_rules,
+            )
+
+            self.mesh = mesh
+            self._param_specs = match_partition_rules(_tp_rules(config),
+                                                      params)
+            self._cache_specs = paged_cache_specs(config, self.cache)
+            self.params = jax.device_put(
+                params,
+                jax.tree.map(lambda s: NamedSharding(mesh, s),
+                             self._param_specs),
+            )
+            self.cache = jax.device_put(
+                self.cache,
+                jax.tree.map(lambda s: NamedSharding(mesh, s),
+                             self._cache_specs),
+            )
+        else:
+            self.mesh = None
+            self.params = params
+
+    # ---- program builders (cached per static shape) ----
+
+    def _model(self):
+        from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+        return TransformerLM(self.config)
+
+    def _chunk_fn(self, k_pad: int, wp: int):
+        key = (k_pad, wp)
+        fn = self._chunk_fns.get(key)
+        if fn is not None:
+            return fn
+        model = self._model()
+        n_slots = self.n_slots
+
+        def body(params, cache, logits, tokens, starts, tables, slots,
+                 is_last, last_idx):
+            out, variables = model.apply(
+                {"params": params, "cache": cache},
+                tokens,
+                position_offset=starts,
+                prefill=True,
+                block_tables=tables,
+                mutable=["cache"],
+            )
+            # logits at each prompt's LAST real token — the distribution
+            # for its first decoded token; written only for final chunks.
+            # Padding jobs carry slot == n_slots: the scatter drops them.
+            row = jnp.take_along_axis(
+                out, last_idx[:, None, None], axis=1
+            )[:, 0]
+            new_logits = logits.at[slots].set(
+                jnp.where(is_last[:, None], row, logits[slots])
+            )
+            return variables["cache"], new_logits
+
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from pytorch_distributed_tpu.parallel.mesh import shard_map
+
+            body = shard_map(
+                body, mesh=self.mesh,
+                in_specs=(self._param_specs, self._cache_specs, P(), P(),
+                          P(), P(), P(), P(), P()),
+                out_specs=(self._cache_specs, P()),
+                check_vma=False,
+            )
+        fn = jax.jit(body, donate_argnums=(1, 2))
+        self._chunk_fns[key] = fn
+        return fn
+
+    def _decode(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        from pytorch_distributed_tpu.models.generate import _sample
+
+        model = self._model()
+        temp, topk = self.temperature, self.top_k
+
+        def body(params, cache, logits, positions, active, tables, rng):
+            tokens = _sample(logits, rng, temp, topk)
+            out, variables = model.apply(
+                {"params": params, "cache": cache},
+                tokens[:, None],
+                position_offset=positions,
+                decode=True,
+                block_tables=tables,
+                mutable=["cache"],
+            )
+            # Inactive lanes: cache writes already routed to the trash
+            # block (host-masked tables); logits rows are dead state,
+            # replaced by the slot's next final prefill chunk before they
+            # are read. Positions stay frozen — the caller reads them.
+            positions = jnp.where(active, positions + 1, positions)
+            return variables["cache"], out[:, 0], positions, tokens
+
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from pytorch_distributed_tpu.parallel.mesh import shard_map
+
+            body = shard_map(
+                body, mesh=self.mesh,
+                in_specs=(self._param_specs, self._cache_specs, P(), P(),
+                          P(), P(), P()),
+                out_specs=(self._cache_specs, P(), P(), P()),
+                check_vma=False,
+            )
+        self._decode_fn = jax.jit(body, donate_argnums=(1, 2))
+        return self._decode_fn
+
+    # ---- slot-level operations ----
+
+    def blocks_for(self, prompt_len: int, max_new_tokens: int) -> int:
+        return blocks_needed(prompt_len, max_new_tokens, self.block_len,
+                             self.chunk)
+
+    def admit(self, slot: int, prompt_len: int, max_new_tokens: int) -> bool:
+        """Allocate ``slot``'s block chain and write its table row — the
+        O(1)-ish host half of admission (the device half is the chunk
+        program). Returns False (state unchanged) when the pool cannot
+        serve the chain: the deterministic OOM the scheduler queues on."""
+        need = self.blocks_for(prompt_len, max_new_tokens)
+        if need > self.table_width:
+            raise ValueError(
+                f"request needs {need} blocks > table width "
+                f"{self.table_width} (max_seq_len {self.config.max_seq_len}"
+                f" / block_len {self.block_len})"
+            )
+        chain = self.allocator.alloc(slot, need)
+        if chain is None:
+            return False
+        self.tables[slot] = TRASH_BLOCK
+        self.tables[slot, :need] = chain
+        return True
+
+    def release(self, slot: int) -> None:
+        """Free the slot's chain and point its table row at the trash
+        block, so the shared decode program's garbage writes for this
+        (now inactive) lane can never touch recycled blocks."""
+        self.allocator.free(slot)
+        self.tables[slot] = TRASH_BLOCK
+
+    def run_chunks(self, jobs: List[ChunkJob]) -> None:
+        """ONE compiled program prefilling one chunk for each job.
+
+        The job count pads to a power of two and the table slice to the
+        narrowest power-of-two block count covering every job's chunk end
+        — so the program's shapes (and cost) follow the PROMPT bucket,
+        never the pool. Chunks of one prompt must be submitted in order
+        (chunk n+1 attends to chunk n's writes through the pool)."""
+        if not jobs:
+            return
+        c = self.chunk
+        for j in jobs:
+            if len(j.tokens) != c:
+                raise ValueError(
+                    f"chunk job for slot {j.slot} has {len(j.tokens)} "
+                    f"tokens; engine chunk length is {c}"
+                )
+        k_pad = _pow2_bucket(len(jobs))
+        max_end = max(j.start + c for j in jobs)
+        wp = min(_pow2_bucket(-(-max_end // self.block_len)),
+                 self.table_width)
+        tokens = np.zeros((k_pad, c), np.int32)
+        starts = np.zeros((k_pad,), np.int32)
+        tables = np.full((k_pad, wp), TRASH_BLOCK, np.int32)
+        # padding jobs scatter to slot n_slots — out of bounds, dropped
+        slots = np.full((k_pad,), self.n_slots, np.int32)
+        is_last = np.zeros((k_pad,), bool)
+        last_idx = np.zeros((k_pad,), np.int32)
+        for i, j in enumerate(jobs):
+            tokens[i] = j.tokens
+            starts[i] = j.start
+            tables[i] = self.tables[j.slot, :wp]
+            slots[i] = j.slot
+            is_last[i] = j.is_last
+            last_idx[i] = j.last_idx
+        fn = self._chunk_fn(k_pad, wp)
+        self.cache, self.logits = fn(
+            self.params, self.cache, self.logits, jnp.asarray(tokens),
+            jnp.asarray(starts), jnp.asarray(tables), jnp.asarray(slots),
+            jnp.asarray(is_last), jnp.asarray(last_idx),
+        )
+
+    def decode(self, positions: np.ndarray, active: np.ndarray, rng):
+        """One decode tick for every slot; samples from the logits
+        buffer, writes each active lane's token at its position, returns
+        ``(tokens [n_slots], new_positions)``. Inactive lanes compute
+        dead garbage routed to the trash block."""
+        masked = np.where(active[:, None], self.tables, TRASH_BLOCK)
+        fn = self._decode()
+        self.cache, self.logits, positions, tokens = fn(
+            self.params, self.cache, self.logits,
+            jnp.asarray(positions, jnp.int32), jnp.asarray(active),
+            jnp.asarray(masked), rng,
+        )
+        return np.asarray(tokens), np.array(positions)

@@ -1,0 +1,134 @@
+"""Block-pooled KV cache: allocator, pool pytree, TP placement.
+
+The paged layout (Kwon et al., SOSP 2023) stores every resident request's
+KV in fixed-size blocks drawn from one shared pool
+``[n_blocks, block_len, H_kv, D]`` per layer. A request's logical
+positions ``[w*block_len, (w+1)*block_len)`` live in the pool block its
+block-table row names at column ``w`` — so admission allocates fresh
+blocks and writes ONLY the new prompt's KV (O(prompt)), never touching
+resident requests' blocks, where the dense layout wrote a full
+``max_seq_len`` row per admission (O(per-slot cache)).
+
+Block 0 is the TRASH block: never allocated, it absorbs the scatter
+writes of inactive decode lanes (the engine zeroes retired slots' table
+rows) so a recycled block can never be corrupted by a dead lane's
+garbage write. Gathers through trash entries are masked by the causal
+mask — an unallocated entry's logical positions exceed every live query
+position.
+
+Allocation is HOST-side and deterministic: a LIFO free list (freshly
+freed blocks are reused first — warmer in cache) with an explicit
+``None`` on insufficient capacity, so the scheduler queues the request
+instead of crashing (the "deterministic OOM → queue" contract).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+TRASH_BLOCK = 0
+
+
+def blocks_needed(prompt_len: int, max_new_tokens: int, block_len: int,
+                  chunk: int) -> int:
+    """Blocks a request must own before admission: enough to hold the
+    chunk-PADDED prefill writes (the final chunk's padding garbage lands
+    in owned blocks, dead until decode overwrites it — same argument as
+    the dense layout's right-padding) and the decode frontier
+    ``prompt_len + max_new_tokens``."""
+    padded_prefill = math.ceil(prompt_len / chunk) * chunk
+    return math.ceil(max(padded_prefill, prompt_len + max_new_tokens)
+                     / block_len)
+
+
+class BlockAllocator:
+    """Free-list allocator over pool block ids ``1..n_blocks-1`` (0 is
+    the trash block) with per-owner chain tracking.
+
+    ``alloc`` is all-or-nothing: it returns the chain or ``None`` with
+    the free list untouched — the deterministic OOM signal the scheduler
+    turns into queueing. ``free`` returns a chain LIFO, so the next
+    allocation reuses the most recently freed blocks (asserted in
+    tests/test_paged_serving.py)."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"n_blocks must be >= 2 (block 0 is the trash block), "
+                f"got {n_blocks}"
+            )
+        self.n_blocks = n_blocks
+        # LIFO: pop from the end; initialized so the FIRST allocations
+        # hand out 1, 2, 3, ... (deterministic, test-friendly order).
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._chains: Dict[int, List[int]] = {}
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return (self.n_blocks - 1) - len(self._free)
+
+    def chain(self, owner: int) -> List[int]:
+        return list(self._chains.get(owner, ()))
+
+    def alloc(self, owner: int, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks for ``owner`` (a slot id). Returns the
+        chain, or ``None`` (state unchanged) when fewer than ``n`` blocks
+        are free."""
+        if n < 1:
+            raise ValueError(f"alloc needs n >= 1, got {n}")
+        if owner in self._chains:
+            raise ValueError(f"owner {owner} already holds a chain")
+        if len(self._free) < n:
+            return None  # deterministic OOM: the caller queues
+        chain = [self._free.pop() for _ in range(n)]
+        self._chains[owner] = chain
+        return list(chain)
+
+    def free(self, owner: int) -> None:
+        """Release ``owner``'s chain back to the free list (LIFO reuse).
+        Freeing an owner without a chain is a no-op — retirement paths
+        may race a request that never got blocks."""
+        chain = self._chains.pop(owner, None)
+        if chain:
+            self._free.extend(reversed(chain))
+
+
+def init_paged_cache(config, params, n_blocks: int, block_len: int):
+    """Zero block-pooled KV cache for ``TransformerLM(config)``.
+
+    Shapes come from ``eval_shape`` on the dense decode cache at batch 1
+    (nothing is traced into a compiled program), then every
+    ``[1, max_seq_len, H_kv, D]`` leaf is re-shaped into a
+    ``[n_blocks, block_len, H_kv, D]`` pool — the per-layer head count
+    and dtype (GQA narrows H_kv; TP shards it by placement) carry over
+    unchanged, so the pool works for every config the dense cache does.
+    """
+    from pytorch_distributed_tpu.models.generate import init_cache
+
+    if block_len < 1:
+        raise ValueError(f"block_len must be >= 1, got {block_len}")
+    shapes = jax.eval_shape(
+        lambda p: init_cache(config, p, 1), params
+    )
+    return jax.tree.map(
+        lambda s: jnp.zeros((n_blocks, block_len) + s.shape[2:], s.dtype),
+        shapes,
+    )
+
+
+def paged_cache_specs(config, cache):
+    """TP placement for the pool: the HEAD dim (axis 2 — same leaf rank
+    as the dense cache) shards over the model axis, exactly the slice
+    each shard's Attention computes. Reuses the dense serving rule
+    (``models.generate._cache_specs``) so the two layouts cannot drift."""
+    from pytorch_distributed_tpu.models.generate import _cache_specs
+
+    return _cache_specs(config, cache)

@@ -1,0 +1,284 @@
+"""Continuous scheduler over the paged engine.
+
+Policy (Orca-style continuous batching with chunked prefill):
+
+- **FIFO admission, batched**: each ``step()`` admits up to
+  ``admit_per_step`` queued requests — strictly in submit order, stopping
+  at the first that cannot get a slot or a block chain (no head-of-line
+  skipping: deterministic, starvation-free). All admitted-and-unfinished
+  prompts advance by ONE chunk per step through a single compiled chunk
+  program (``PagedEngine.run_chunks``), so a long prompt never stalls the
+  decode lanes — it interleaves, chunk by chunk, with everyone else's
+  decode ticks.
+- **decode**: every fully-prefilled slot with budget advances one token
+  per step; EOS (when configured) retires a slot early. Retirement frees
+  the block chain immediately — the freed blocks are the next
+  admission's allocation (LIFO).
+- **OOM queues**: a request that cannot be served *now* (no free slot, or
+  the pool cannot supply its chain) simply stays queued. ``submit``
+  never raises for capacity reasons — only for requests that could never
+  fit (``> max_seq_len``).
+
+Metrics are exact host-side counters, no device sync beyond the token
+fetch the caller already pays: slot occupancy, block-pool occupancy,
+padding-waste fraction (allocated-but-unwritten block capacity),
+admission latency (steps and wall seconds from submit to admission),
+queue depth, and tokens/s.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    tokens: np.ndarray  # [L] int32 prompt
+    max_new_tokens: int
+    submit_step: int
+    submit_time: float
+    slot: int = -1  # -1 while queued
+    prefill_done: int = 0  # tokens prefilled so far (chunk multiple)
+    produced: int = 0
+    admit_step: int = -1
+    admit_time: float = float("nan")
+
+    @property
+    def length(self) -> int:
+        return int(len(self.tokens))
+
+
+class Scheduler:
+    """Continuous paged-KV scheduler: ``submit`` enqueues, ``step``
+    advances the whole system one tick, ``drain`` runs to empty.
+
+    ``step()`` returns ``[(rid, token)]`` for the tokens produced this
+    tick — request ids, not slots (slots recycle; rids don't).
+    """
+
+    def __init__(self, config, params, n_slots: int, *,
+                 n_blocks: Optional[int] = None, block_len: int = 16,
+                 prefill_chunk: int = 64, admit_per_step: int = 4,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 seed: int = 0, eos_id: Optional[int] = None, mesh=None):
+        from pytorch_distributed_tpu.serving.engine import PagedEngine
+
+        if eos_id is not None and not 0 <= eos_id < config.vocab_size:
+            raise ValueError(
+                f"eos_id {eos_id} outside [0, vocab_size={config.vocab_size})"
+            )
+        if admit_per_step < 1:
+            raise ValueError(
+                f"admit_per_step must be >= 1, got {admit_per_step}"
+            )
+        self.engine = PagedEngine(
+            config, params, n_slots, n_blocks=n_blocks, block_len=block_len,
+            prefill_chunk=prefill_chunk, temperature=temperature,
+            top_k=top_k, mesh=mesh,
+        )
+        self.config = config
+        self.n_slots = n_slots
+        self.admit_per_step = admit_per_step
+        self.eos_id = eos_id
+        self._rng = jax.random.key(seed)
+        self._next_rid = 0
+        self._step_count = 0
+        self.queue: deque = deque()
+        self.resident: Dict[int, Request] = {}  # slot -> request
+        self.positions = np.zeros(n_slots, np.int32)
+        self.remaining = np.zeros(n_slots, np.int32)
+        # ---- exact host-side metric counters ----
+        self._tokens_out = 0
+        self._completed = 0
+        self._admitted = 0
+        self._adm_latency_steps = 0
+        self._adm_latency_s = 0.0
+        self._occupancy_sum = 0.0  # mean-able over steps
+        self._start_time: Optional[float] = None
+
+    # ---- API ----
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
+        """Enqueue one request; returns its request id. Never raises for
+        capacity — only for requests no configuration could serve."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        l = len(prompt)
+        if l < 1:
+            raise ValueError("prompt must contain at least one token")
+        c = self.engine.chunk
+        padded = -(-l // c) * c
+        if padded > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({l}) padded to {padded} exceeds max_seq_len "
+                f"{self.config.max_seq_len}"
+            )
+        if l + max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({l}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len {self.config.max_seq_len}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self.queue.append(Request(
+            rid=rid, tokens=prompt, max_new_tokens=max_new_tokens,
+            submit_step=self._step_count, submit_time=time.perf_counter(),
+        ))
+        return rid
+
+    def _free_slots(self) -> List[int]:
+        return [s for s in range(self.n_slots) if s not in self.resident]
+
+    def _admit(self) -> None:
+        """Admit up to ``admit_per_step`` queue-head requests that can be
+        served now. Strict FIFO: the first request that cannot get a slot
+        or a chain stops admission for this step."""
+        free = self._free_slots()
+        admitted = 0
+        now = time.perf_counter()
+        while self.queue and free and admitted < self.admit_per_step:
+            req = self.queue[0]
+            slot = free[0]
+            if not self.engine.admit(slot, req.length, req.max_new_tokens):
+                break  # pool OOM: queue (blocks free as others retire)
+            self.queue.popleft()
+            free.pop(0)
+            req.slot = slot
+            req.admit_step = self._step_count
+            req.admit_time = now
+            self.resident[slot] = req
+            self.positions[slot] = 0
+            self.remaining[slot] = 0  # decode-armed after the last chunk
+            self._admitted += 1
+            self._adm_latency_steps += self._step_count - req.submit_step
+            self._adm_latency_s += now - req.submit_time
+            admitted += 1
+
+    def _chunk_jobs(self):
+        from pytorch_distributed_tpu.serving.engine import ChunkJob
+
+        c = self.engine.chunk
+        jobs = []
+        for slot, req in sorted(self.resident.items()):
+            if req.prefill_done >= req.length:
+                continue
+            start = req.prefill_done
+            seg = req.tokens[start:start + c]
+            tokens = np.zeros((c,), np.int32)
+            tokens[:len(seg)] = seg
+            is_last = start + c >= req.length
+            jobs.append(ChunkJob(
+                slot=slot, tokens=tokens, start=start, is_last=is_last,
+                last_idx=(req.length - 1 - start) if is_last else 0,
+            ))
+        return jobs
+
+    def step(self) -> List[Tuple[int, int]]:
+        """One tick: admissions → one prefill chunk per unfinished prompt
+        (ONE compiled program) → one decode token per ready lane →
+        retirements. Returns ``[(rid, token)]``."""
+        if self._start_time is None:
+            self._start_time = time.perf_counter()
+        self._admit()
+        jobs = self._chunk_jobs()
+        if jobs:
+            self.engine.run_chunks(jobs)
+            for j in jobs:
+                req = self.resident[j.slot]
+                req.prefill_done += self.engine.chunk
+                if req.prefill_done >= req.length:
+                    # prefill complete: arm the decode lane at the
+                    # prompt's true frontier
+                    self.positions[j.slot] = req.length
+                    self.remaining[j.slot] = req.max_new_tokens
+        active = self.remaining > 0
+        self._occupancy_sum += len(self.resident) / self.n_slots
+        self._step_count += 1
+        if not active.any():
+            return []
+        self._rng, sub = jax.random.split(self._rng)
+        tokens, self.positions = self.engine.decode(
+            self.positions, active, sub
+        )
+        out: List[Tuple[int, int]] = []
+        for slot in np.nonzero(active)[0]:
+            slot = int(slot)
+            req = self.resident[slot]
+            token = int(tokens[slot])
+            out.append((req.rid, token))
+            req.produced += 1
+            self._tokens_out += 1
+            if (self.eos_id is not None and token == self.eos_id) or \
+                    req.produced >= req.max_new_tokens:
+                self.remaining[slot] = 0
+                del self.resident[slot]
+                self.engine.release(slot)
+                self._completed += 1
+            else:
+                self.remaining[slot] -= 1
+        return out
+
+    def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """Step until queue and lanes are empty; returns
+        ``{rid: [tokens]}``."""
+        produced: Dict[int, List[int]] = {}
+        for _ in range(max_steps):
+            if not self.queue and not self.resident:
+                return produced
+            for rid, tok in self.step():
+                produced.setdefault(rid, []).append(tok)
+        raise RuntimeError(
+            f"drain did not converge within {max_steps} steps "
+            f"(queue={len(self.queue)}, resident={len(self.resident)})"
+        )
+
+    # ---- metrics ----
+
+    def metrics(self) -> dict:
+        """Exact host-side accounting; all counters, no device sync."""
+        alloc_blocks = self.engine.allocator.in_use
+        alloc_tokens = alloc_blocks * self.engine.block_len
+        used_tokens = int(sum(
+            # tokens actually written and live for the request: its
+            # prefill frontier plus produced decode tokens
+            min(r.prefill_done, r.length) + r.produced
+            for r in self.resident.values()
+        ))
+        elapsed = (
+            time.perf_counter() - self._start_time
+            if self._start_time is not None else 0.0
+        )
+        return {
+            "steps": self._step_count,
+            "queue_depth": len(self.queue),
+            "occupancy": len(self.resident) / self.n_slots,
+            "occupancy_mean": (
+                self._occupancy_sum / self._step_count
+                if self._step_count else 0.0
+            ),
+            "pool_blocks_in_use": alloc_blocks,
+            "pool_frac_in_use": (
+                alloc_blocks / (self.engine.allocator.n_blocks - 1)
+            ),
+            "padding_waste_frac": (
+                1.0 - used_tokens / alloc_tokens if alloc_tokens else 0.0
+            ),
+            "admitted": self._admitted,
+            "completed": self._completed,
+            "tokens_out": self._tokens_out,
+            "tokens_per_s": self._tokens_out / elapsed if elapsed else 0.0,
+            "admission_latency_steps_mean": (
+                self._adm_latency_steps / self._admitted
+                if self._admitted else 0.0
+            ),
+            "admission_latency_s_mean": (
+                self._adm_latency_s / self._admitted
+                if self._admitted else 0.0
+            ),
+        }

@@ -149,9 +149,18 @@ def _vocab_rules(config):
 
 
 def _uses_vocab_parallel(config) -> bool:
+    """Delegates to ``TransformerConfig.uses_vocab_parallel`` — the ONE
+    predicate the model's head/embedding branch also consults, so the
+    placement rules here and the collective branch in
+    ``models/transformer.py`` cannot diverge (ADVICE r5 #3). The inline
+    fallback covers duck-typed test configs without the method."""
+    if config is None:
+        return False
+    fn = getattr(config, "uses_vocab_parallel", None)
+    if fn is not None:
+        return bool(fn())
     return (
-        config is not None
-        and getattr(config, "vocab_parallel", False)
+        getattr(config, "vocab_parallel", False)
         and config.model_axis is not None
         and config.tp_size > 1
     )

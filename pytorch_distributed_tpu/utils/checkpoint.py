@@ -840,6 +840,24 @@ def validate_checkpoint(dirpath: str | os.PathLike) -> list:
 STEP_CKPT_RE = re.compile(r"^step-(\d{8,})\.ckpt$")  # 8+: :08d overflows
 
 
+def legacy_checkpoint_step(path: str | os.PathLike) -> int:
+    """``state/step`` of a LEGACY single-file msgpack checkpoint.
+
+    The sharded ranking reads the step with a cheap ``peek_leaf``; the
+    legacy format has no manifest, so this restores the msgpack blob and
+    digs out ``state/step`` (falling back to the top-level ``step`` the
+    payload also carries). Before round 6 the ranking hardcoded legacy
+    files to step 0 — a single-file suspend save at step 1000 would LOSE
+    resume to a step-100 interval checkpoint (ADVICE r5 #1)."""
+    with open(os.fspath(path), "rb") as f:
+        sd = serialization.msgpack_restore(f.read())
+    node = sd.get("state", {})
+    step = node.get("step") if isinstance(node, dict) else None
+    if step is None:
+        step = sd["step"]  # KeyError → caller logs and discards
+    return int(np.asarray(step))
+
+
 class Checkpointer:
     """latest/best artifact manager for a save directory.
 
@@ -1035,13 +1053,22 @@ class Checkpointer:
         candidates = [p for _s, p in self.step_checkpoints()]
         if self.has_latest():
             candidates.append(self.latest_path)
+            if not os.path.isdir(self.latest_path) and len(candidates) > 1:
+                rank0_print(
+                    f"checkpoint fallback: legacy single-file "
+                    f"{self.latest_path} coexists with sharded step "
+                    "checkpoints; ranking it by its recorded state/step"
+                )
         ranked = []  # (step, tie_rank, path): later candidates win ties
         for rank, p in enumerate(candidates):
             try:
                 if os.path.isdir(p):
                     s = int(np.asarray(peek_leaf(p, "state/step")))
-                else:  # legacy single-file latest: prefer only if alone
-                    s = 0
+                else:
+                    # legacy single-file latest: rank by its REAL step
+                    # (hardcoding 0 here let an older interval save win
+                    # resume over a newer suspend save — ADVICE r5 #1)
+                    s = legacy_checkpoint_step(p)
             except Exception as e:
                 rank0_print(
                     f"checkpoint fallback: discarding {p} "

@@ -1,0 +1,124 @@
+"""Paged-KV continuous-batching serving demo (round 6 tentpole).
+
+Drives ``serving.Scheduler`` — the block-pooled KV cache with O(prompt)
+admission, chunked prefill interleaved with decode, FIFO queueing on pool
+OOM — over a synthetic multi-tenant workload, and prints the scheduler's
+exact host-side metrics (occupancy, padding waste, admission latency,
+queue depth, tokens/s). Zero required args; CPU-runnable:
+
+    python recipes/serve_lm.py --tiny                 # CPU smoke
+    python recipes/serve_lm.py --requests 64 --slots 16 --max-new 32
+    python recipes/serve_lm.py --dense                # r4 layout A/B
+
+``--dense`` runs the same workload through the legacy dense
+``ContinuousBatcher`` layout (one max_seq_len KV row per slot, admission
+copying the full row) for an on-box A/B of the admission tax the paged
+engine removes; ANALYSIS.md "Serving engine" documents the design.
+"""
+
+from common import parse_args  # noqa: F401  (bootstraps sys.path)
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import pytorch_distributed_tpu as pdt
+
+pdt.set_env("202607")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pytorch_distributed_tpu.models.generate import (  # noqa: E402
+    ContinuousBatcher,
+)
+from pytorch_distributed_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig,
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.serving import Scheduler  # noqa: E402
+from pytorch_distributed_tpu.utils.logging import rank0_print  # noqa: E402
+
+
+def _parse() -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny config (CPU smoke)")
+    p.add_argument("--requests", type=int, default=24,
+                   help="synthetic requests to serve")
+    p.add_argument("--slots", type=int, default=8, help="decode lanes")
+    p.add_argument("--max-new", type=int, default=16,
+                   help="decode budget per request")
+    p.add_argument("--block-len", type=int, default=16,
+                   help="KV block length (paged layout)")
+    p.add_argument("--prefill-chunk", type=int, default=32,
+                   help="prefill chunk length (paged) / bucket (dense)")
+    p.add_argument("--admit-per-step", type=int, default=4,
+                   help="max admissions per scheduler tick")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dense", action="store_true",
+                   help="run the r4 dense layout instead (A/B reference)")
+    return p.parse_args()
+
+
+def _model(args):
+    if args.tiny or jax.default_backend() == "cpu":
+        cfg = tiny_config(attention="dense", max_seq_len=128)
+    else:
+        cfg = TransformerConfig(
+            vocab_size=32_000, num_layers=12, num_heads=12, embed_dim=768,
+            max_seq_len=2048, attention="dense", dropout=0.0,
+        )
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def _prompts(args, cfg):
+    rng = np.random.default_rng(args.seed)
+    lens = rng.integers(4, cfg.max_seq_len - args.max_new,
+                        size=args.requests)
+    return [rng.integers(1, cfg.vocab_size, size=l).astype(np.int32)
+            for l in lens]
+
+
+def main() -> None:
+    args = _parse()
+    cfg, params = _model(args)
+    prompts = _prompts(args, cfg)
+    t0 = time.perf_counter()
+    if args.dense:
+        # r4 layout: no queue — submit when a slot frees, the admission
+        # itself copying the slot's full max_seq_len KV row
+        b = ContinuousBatcher(
+            cfg, params, n_slots=args.slots, seed=args.seed,
+            prefill_bucket=args.prefill_chunk, cache_layout="dense",
+        )
+        waiting = list(prompts)
+        done = 0
+        while waiting or any(b.remaining > 0):
+            while waiting and b.free_slots():
+                b.submit(waiting.pop(0), args.max_new)
+            done += len(b.step())
+        metrics = {"layout": "dense", "tokens_out": done}
+    else:
+        s = Scheduler(
+            cfg, params, n_slots=args.slots, block_len=args.block_len,
+            prefill_chunk=args.prefill_chunk,
+            admit_per_step=args.admit_per_step, seed=args.seed,
+        )
+        for p in prompts:
+            s.submit(p, args.max_new)
+        streams = s.drain()
+        metrics = {"layout": "paged", **s.metrics()}
+        assert len(streams) == args.requests
+    metrics["wall_s"] = round(time.perf_counter() - t0, 2)
+    rank0_print(json.dumps(metrics, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -136,7 +136,10 @@ def measure_admission_stall(slots: int = 32, n: int = 10,
     from pytorch_distributed_tpu.models.generate import ContinuousBatcher
 
     cfg, params = _gpt2_model()
-    b = ContinuousBatcher(cfg, params, n_slots=slots, prefill_bucket=128)
+    # the DENSE layout's stall — the number the paged engine exists to
+    # beat; measure_paged_admission reports the paged counterpart
+    b = ContinuousBatcher(cfg, params, n_slots=slots, prefill_bucket=128,
+                          cache_layout="dense")
 
     rng = np.random.default_rng(0)
     out: dict = {"serving_stall_slots": slots}
@@ -196,6 +199,69 @@ def measure_admission_stall(slots: int = 32, n: int = 10,
     return out
 
 
+def measure_paged_admission(slots: int = 32, n: int = 10,
+                            tick_ms: float | None = None) -> dict:
+    """Per-admission cost of the PAGED engine (the round-6 tentpole) and
+    the equilibrium short-output throughput model it implies — the
+    admission-heavy workload where the dense layout paid its ~30% tax.
+
+    An admission here is ``ContinuousBatcher.submit`` on the default
+    paged layout: block-chain allocation (host) + one chunk program per
+    prompt chunk writing into FRESH blocks — O(prompt), never touching
+    resident KV. Timed as chained dispatch over ``n`` admissions into
+    distinct slots with ONE sync, round-trip subtracted (same method as
+    the dense stall). Reported per prefill-chunk bucket alongside the
+    same closed-form equilibrium throughput the dense measurement uses,
+    so ``serving_paged_admission_overhead_frac_new64`` is directly
+    comparable with ``serving_admission_overhead_frac_new64``.
+    """
+    from pytorch_distributed_tpu.models.generate import ContinuousBatcher
+
+    cfg, params = _gpt2_model()
+    b = ContinuousBatcher(cfg, params, n_slots=slots, prefill_bucket=128)
+    rng = np.random.default_rng(0)
+    out: dict = {
+        "serving_paged_block_len": b.engine.block_len,
+        "serving_paged_chunk": b.engine.chunk,
+    }
+
+    stall_by_bucket = {}
+    for width in (128, 256):
+        prompt = rng.integers(
+            1, cfg.vocab_size, (width - 7,)
+        ).astype(np.int32)
+        for _ in range(2):  # compile + settle donation
+            b.submit(prompt, 1)
+            b.step()  # budget 1: retires, frees the slot and its blocks
+        jax.block_until_ready(b.logits)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            b.submit(prompt, 1)
+        jax.block_until_ready(b.logits)
+        dt = time.perf_counter() - t0
+        while any(b.remaining > 0):
+            b.step()
+        stall_by_bucket[width] = (
+            max(dt - measure_roundtrip_s(), dt / 2) / n * 1e3
+        )
+        out[f"serving_paged_admission_stall_ms_b{width}"] = round(
+            stall_by_bucket[width], 2
+        )
+
+    if tick_ms is None:
+        tick_ms = measure(slots=slots, max_new=64)[
+            "serving_decode_ms_per_token"
+        ]
+    stall = stall_by_bucket[256]
+    for max_new in (64, 256):
+        eff = slots * max_new / (slots * stall + max_new * tick_ms) * 1e3
+        out[f"serving_paged_equilibrium_tok_s_new{max_new}"] = round(eff)
+        out[f"serving_paged_admission_overhead_frac_new{max_new}"] = round(
+            slots * stall / (slots * stall + max_new * tick_ms), 3
+        )
+    return out
+
+
 def measure_tp_virtual(slots: int = 8, tp: int = 2) -> dict:
     """TP batcher decode rate on the VIRTUAL CPU mesh — a functionality
     row, not a performance claim (tp>1 needs more chips than this
@@ -240,6 +306,9 @@ def main() -> None:
         slots = int(sys.argv[sys.argv.index("--slots") + 1])
     if "--stall" in sys.argv:
         print(json.dumps(measure_admission_stall(slots)))
+        return
+    if "--paged-stall" in sys.argv:
+        print(json.dumps(measure_paged_admission(slots)))
         return
     if "--tp-virtual" in sys.argv:
         print(json.dumps(measure_tp_virtual()))

@@ -5,12 +5,17 @@
 # nor recorded with a reason in scripts/jaxlint_baseline.json — so NEW
 # hazards fail the build while the reviewed pre-existing ones don't.
 #
-# Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke]
+# Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke|--serving-smoke]
 #
 # --resilience-smoke: lint, then ONE crash-recovery cycle from the
 # kill-matrix (SIGKILL mid-shard-write → relaunch → assert resume) —
 # the cheap end-to-end proof that crash recovery still works, without
 # the full tier-1 suite or the whole @crash matrix.
+#
+# --serving-smoke: lint, then ONE paged-engine submit/decode/drain
+# cycle (tests/test_paged_serving.py::test_serving_smoke) — the cheap
+# end-to-end proof the paged serving path still admits, decodes, and
+# returns its blocks, without the parity/TP tier.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -26,6 +31,14 @@ if [[ "${1:-}" == "--resilience-smoke" ]]; then
     JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
         -m crash -k shard_write -p no:cacheprovider -p no:xdist \
         -p no:randomly
+    exit 0
+fi
+
+if [[ "${1:-}" == "--serving-smoke" ]]; then
+    echo "== serving smoke (paged submit → decode → drain) =="
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_paged_serving.py::test_serving_smoke -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly
     exit 0
 fi
 

@@ -226,3 +226,42 @@ def test_flash_fused_backward_matches_split():
         g_fused = jax.grad(loss("fused"), argnums=(0, 1, 2))(q, k, v)
         for a, bb in zip(g_fused, g_split):
             np.testing.assert_allclose(a, bb, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_partials_f32_knob():
+    """ADVICE r5 #2: ``partials_f32=True`` keeps the fused backward's dQ
+    partials in fp32. For fp32 inputs the partials already ARE fp32, so
+    the knob must be exactly inert; for bf16 inputs it removes the
+    per-partial bf16 rounding, so the fused dQ must land at least as
+    close to the split backward's pure-fp32 dQ accumulation as the
+    default does."""
+    import numpy as np
+
+    from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+    r = np.random.RandomState(1)
+    raw = [r.randn(2, 256, 2, 32) for _ in range(3)]
+
+    def grads(dtype, impl, pf32):
+        q, k, v = (jnp.asarray(x, dtype) for x in raw)
+        loss = lambda q_, k_, v_: jnp.sum(
+            flash_attention(q_, k_, v_, causal=True, block_q=64,
+                            block_k=64, bwd_impl=impl,
+                            partials_f32=pf32).astype(jnp.float32) ** 2
+        )
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    # fp32: bit-inert (partials were fp32 either way)
+    for a, b in zip(grads(jnp.float32, "fused", True),
+                    grads(jnp.float32, "fused", False)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # bf16: fp32 partials must not be FARTHER from the split (pure-fp32
+    # dQ accumulation) reference than the default bf16 partials
+    dq_split = np.asarray(grads(jnp.bfloat16, "split", False)[0],
+                          np.float32)
+    dq_bf16 = np.asarray(grads(jnp.bfloat16, "fused", False)[0], np.float32)
+    dq_f32 = np.asarray(grads(jnp.bfloat16, "fused", True)[0], np.float32)
+    err = lambda x: np.abs(x - dq_split).max()
+    assert err(dq_f32) <= err(dq_bf16) + 1e-6
+    np.testing.assert_allclose(dq_f32, dq_split, rtol=2e-2, atol=2e-2)

@@ -227,3 +227,26 @@ def test_pp_step_fused_vs_unfused():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
         p_fused, p_ref,
     )
+
+
+def test_bf16_weights_cotangent_dtype():
+    """ADVICE r5 #4: the weights cotangent must come back at the PRIMAL
+    weights dtype. The backward used to hardcode fp32, which failed deep
+    inside the vjp trace for bf16 weights; now grad wrt bf16 weights
+    works and lands at bf16 (per-token loss stays fp32 until the final
+    cast)."""
+    x, k, labels, _ = _rand()
+    w = jnp.ones(x.shape[0], jnp.bfloat16)
+
+    ref = jax.grad(
+        lambda w_: _ref_loss_sum(x, k, labels, w_.astype(jnp.float32))
+    )(w.astype(jnp.float32))
+    got = jax.grad(
+        lambda w_: fused_linear_cross_entropy(
+            x, k, labels, w_, block_n=8, compute_dtype=jnp.float32
+        )
+    )(w)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref), rtol=1e-2, atol=1e-2
+    )

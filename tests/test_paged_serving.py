@@ -1,0 +1,457 @@
+"""Paged-KV serving engine (round 6 tentpole): block allocator, paged
+attention math, dense-vs-paged token parity (single device and TP=2),
+chunked-prefill equivalence, scheduler policy + exact metrics, and the
+admission-cost scaling micro-bench (cost-analysis bytes: paged flat in
+pool size, dense growing with it)."""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models.generate import ContinuousBatcher, generate
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.ops.attention import paged_attention
+from pytorch_distributed_tpu.serving import (
+    TRASH_BLOCK,
+    BlockAllocator,
+    PagedEngine,
+    Scheduler,
+    blocks_needed,
+)
+from pytorch_distributed_tpu.serving.engine import ChunkJob
+
+
+def setup(max_seq_len=96, **over):
+    cfg = tiny_config(attention="dense", max_seq_len=max_seq_len, **over)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def greedy_reference(cfg, params, prompt, max_new):
+    full = generate(
+        cfg, params, jnp.asarray(prompt)[None, :], jax.random.key(1),
+        max_new_tokens=max_new, temperature=0.0,
+    )
+    return np.asarray(full)[0, len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# block allocator (pure host logic — fast tier)
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_alloc_free_reuse_oom():
+    a = BlockAllocator(8)  # ids 1..7 usable, 0 is trash
+    assert a.available == 7 and a.in_use == 0
+    c0 = a.alloc(0, 3)
+    assert c0 == [1, 2, 3]  # deterministic first-allocation order
+    assert TRASH_BLOCK not in c0
+    c1 = a.alloc(1, 3)
+    assert c1 == [4, 5, 6]
+    # OOM is a deterministic None with state UNCHANGED — the queue signal
+    assert a.alloc(2, 2) is None
+    assert a.available == 1 and a.chain(2) == []
+    # free → LIFO reuse: the just-freed blocks come back first
+    a.free(0)
+    assert a.available == 4
+    c2 = a.alloc(2, 2)
+    assert c2 == [1, 2]
+    # double-alloc for a live owner is a bug, not a silent leak
+    with pytest.raises(ValueError, match="already holds"):
+        a.alloc(1, 1)
+    a.free(99)  # unknown owner: no-op
+    with pytest.raises(ValueError, match="n_blocks"):
+        BlockAllocator(1)
+
+
+def test_blocks_needed_covers_padded_prefill_and_decode():
+    # prompt 9 padded to chunk 16 → 1 block of 16; decode to 9+20=29 → 2
+    assert blocks_needed(9, 20, block_len=16, chunk=16) == 2
+    # chunk padding dominates: prompt 17 pads to 32 > 17+4
+    assert blocks_needed(17, 4, block_len=16, chunk=16) == 2
+    assert blocks_needed(1, 1, block_len=16, chunk=16) == 1
+
+
+# ---------------------------------------------------------------------------
+# paged attention math (pure op — fast tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("h_kv,c", [(4, 1), (4, 5), (2, 5)])
+def test_paged_attention_matches_masked_reference(h_kv, c):
+    """Gather-over-blocks attention == a straight masked softmax over the
+    same logical sequences, including the GQA narrow-head layout."""
+    b, h, d, bl, w = 2, 4, 8, 4, 3
+    L = w * bl
+    rng = np.random.default_rng(0)
+    k_seq = rng.normal(size=(b, L, h_kv, d)).astype(np.float32)
+    v_seq = rng.normal(size=(b, L, h_kv, d)).astype(np.float32)
+    q = jnp.asarray(rng.normal(size=(b, c, h, d)).astype(np.float32))
+    # per-request block chains laid out non-contiguously in the pool
+    n_blocks = 1 + b * w
+    pool_k = np.zeros((n_blocks, bl, h_kv, d), np.float32)
+    pool_v = np.zeros((n_blocks, bl, h_kv, d), np.float32)
+    tables = np.zeros((b, w), np.int32)
+    order = rng.permutation(np.arange(1, n_blocks))
+    for bi in range(b):
+        for wi in range(w):
+            blk = int(order[bi * w + wi])
+            tables[bi, wi] = blk
+            pool_k[blk] = k_seq[bi, wi * bl:(wi + 1) * bl]
+            pool_v[blk] = v_seq[bi, wi * bl:(wi + 1) * bl]
+    q_positions = np.stack([
+        np.arange(L - c, L), np.arange(3, 3 + c)
+    ])[:b].astype(np.int32)
+
+    out = paged_attention(
+        q, jnp.asarray(pool_k), jnp.asarray(pool_v), jnp.asarray(tables),
+        jnp.asarray(q_positions),
+    )
+
+    group = h // h_kv
+    kw = np.repeat(k_seq, group, axis=2)  # widen narrow heads
+    vw = np.repeat(v_seq, group, axis=2)
+    ref = np.zeros((b, c, h, d), np.float32)
+    for bi in range(b):
+        for ci in range(c):
+            p = int(q_positions[bi, ci])
+            logits = np.einsum(
+                "hd,khd->hk", np.asarray(q[bi, ci]) * d ** -0.5,
+                kw[bi, :p + 1],
+            )
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            ref[bi, ci] = np.einsum("hk,khd->hd", probs, vw[bi, :p + 1])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_paged_attention_gather_impl_flag():
+    z = jnp.zeros((1, 1, 2, 4))
+    pool = jnp.zeros((2, 4, 2, 4))
+    t = jnp.zeros((1, 1), jnp.int32)
+    p = jnp.zeros((1, 1), jnp.int32)
+    with pytest.raises(NotImplementedError, match="pallas"):
+        paged_attention(z, pool, pool, t, p, gather_impl="pallas")
+    with pytest.raises(ValueError, match="gather_impl"):
+        paged_attention(z, pool, pool, t, p, gather_impl="nope")
+
+
+# ---------------------------------------------------------------------------
+# admission cost scaling (compiled cost analysis — deterministic, fast tier)
+# ---------------------------------------------------------------------------
+
+
+def _total_bytes(compiled):
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return float(ca["bytes accessed"])
+
+
+def test_admission_cost_paged_flat_dense_grows():
+    """THE tentpole claim, asserted without wall-clock flakiness: grow
+    the KV capacity 8x (max_seq_len 256 → 2048 at fixed slots — the
+    dense layout's pool is n_slots × max_seq_len rows) and compare each
+    layout's compiled admission program by XLA's bytes-accessed cost.
+    Dense admission writes a full per-slot row → must grow; paged
+    admission touches O(prompt) blocks → must stay flat. rope positions
+    keep the param tree identical across capacities, so the cache is the
+    only thing that scales."""
+
+    def build(max_len):
+        cfg = tiny_config(
+            attention="dense", max_seq_len=max_len, pos_embedding="rope",
+            num_heads=4, embed_dim=64,
+        )
+        params = TransformerLM(cfg).init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        return cfg, params
+
+    prompt = np.arange(1, 10, dtype=np.int32)  # 9 tokens, bucket 16
+    padded = np.zeros((1, 16), np.int32)
+    padded[0, :len(prompt)] = prompt
+    costs = {}
+    for max_len in (256, 2048):
+        cfg, params = build(max_len)
+        dense = ContinuousBatcher(
+            cfg, params, n_slots=8, prefill_bucket=16, cache_layout="dense"
+        )
+        dense_bytes = _total_bytes(dense._submit_one.lower(
+            params, jnp.asarray(padded), jnp.asarray([9], jnp.int32),
+            dense.cache, dense.logits, jnp.asarray(0),
+        ).compile())
+        eng = PagedEngine(cfg, params, n_slots=8, block_len=16,
+                          prefill_chunk=16)
+        assert eng.admit(0, len(prompt), 6)
+        paged_bytes = _total_bytes(eng._chunk_fn(1, 1).lower(
+            params, eng.cache, eng.logits, jnp.asarray(padded),
+            jnp.asarray([0], jnp.int32), jnp.asarray(eng.tables[:1, :1]),
+            jnp.asarray([0], jnp.int32), jnp.asarray([True]),
+            jnp.asarray([len(prompt) - 1], jnp.int32),
+        ).compile())
+        costs[max_len] = (dense_bytes, paged_bytes)
+
+    dense_ratio = costs[2048][0] / costs[256][0]
+    paged_ratio = costs[2048][1] / costs[256][1]
+    # measured ~3.2x vs 1.00x on jaxlib 0.4.37; thresholds leave slack
+    # for compiler drift while keeping the asymptotic claim falsifiable
+    assert dense_ratio > 1.5, (
+        f"dense admission no longer scales with capacity ({dense_ratio:.2f}"
+        "x) — if XLA learned to elide the row write, retire this bench "
+        "and the paged engine's motivation section"
+    )
+    assert paged_ratio < 1.1, (
+        f"paged admission grew {paged_ratio:.2f}x with pool capacity — "
+        "an O(pool) term leaked into the chunk program"
+    )
+
+
+# ---------------------------------------------------------------------------
+# smoke (fast tier — scripts/ci_check.sh --serving-smoke runs exactly this)
+# ---------------------------------------------------------------------------
+
+
+def test_serving_smoke():
+    """One full paged cycle: submit → decode steps → drain; slots and
+    blocks return to the pool."""
+    cfg, params = setup(max_seq_len=64)
+    b = ContinuousBatcher(cfg, params, n_slots=2, prefill_bucket=8)
+    assert b.cache_layout == "paged"
+    slot = b.submit(np.arange(1, 10, dtype=np.int32), 4)
+    produced = []
+    while any(b.remaining > 0):
+        produced += b.step()
+    assert len(produced) == 4 and all(s == slot for s, _t in produced)
+    assert b.engine.allocator.in_use == 0  # chain returned
+    assert (b.engine.tables[slot] == TRASH_BLOCK).all()
+    assert b.free_slots() == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy + exact metrics (fast tier — tiny model)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_oom_queues_fifo_and_drains():
+    """A pool too small for everyone at once: admissions stop at the
+    first request that cannot get its chain (strict FIFO), the rest wait
+    in queue, and everything still completes as blocks free up."""
+    cfg, params = setup(max_seq_len=64)
+    # block_len 8, chunk 8: each request (l=9 → padded 16, +4 decode) needs
+    # 2 blocks; pool of 5 usable blocks fits TWO resident requests
+    s = Scheduler(cfg, params, n_slots=4, n_blocks=6, block_len=8,
+                  prefill_chunk=8)
+    prompt = np.arange(1, 10, dtype=np.int32)
+    rids = [s.submit(prompt, 4) for _ in range(4)]
+    s.step()
+    m = s.metrics()
+    assert m["admitted"] == 2  # 3rd request OOM'd → queued, 4th behind it
+    assert m["queue_depth"] == 2
+    assert m["pool_blocks_in_use"] == 4
+    outs = s.drain()
+    assert sorted(outs) == sorted(rids)
+    assert all(len(v) == 4 for v in outs.values())
+    ref = list(greedy_reference(cfg, params, prompt, 4))
+    for r in rids:
+        assert outs[r] == ref  # queueing never changes tokens
+    m = s.metrics()
+    assert m["completed"] == 4 and m["queue_depth"] == 0
+    assert m["pool_blocks_in_use"] == 0 and m["occupancy"] == 0.0
+    # later arrivals waited: admission latency in steps is exact
+    assert m["admission_latency_steps_mean"] > 0
+
+
+def test_scheduler_metrics_exact_accounting():
+    cfg, params = setup(max_seq_len=64)
+    s = Scheduler(cfg, params, n_slots=1, block_len=8, prefill_chunk=8)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    r0 = s.submit(prompt, 3)
+    r1 = s.submit(prompt, 2)
+    outs = s.drain()
+    m = s.metrics()
+    assert m["tokens_out"] == 5 == len(outs[r0]) + len(outs[r1])
+    assert m["admitted"] == m["completed"] == 2
+    # one slot: r0 runs steps 0..3 (chunk step + 3 decode), r1 admitted
+    # the step after r0 retires → latency is deterministic and positive
+    assert s.resident == {} and not s.queue
+    assert 0.0 <= m["occupancy_mean"] <= 1.0
+    assert 0.0 <= m["padding_waste_frac"] <= 1.0
+    assert m["tokens_per_s"] > 0
+    # padding waste while resident: 5-token prompt in 8-token blocks
+    s2 = Scheduler(cfg, params, n_slots=1, block_len=8, prefill_chunk=8)
+    s2.submit(prompt, 2)
+    s2.step()  # chunk runs; first token decoded
+    w = s2.metrics()["padding_waste_frac"]
+    # 1 block of 8 allocated (covers 5+2), 5+1 tokens written → 2/8 waste
+    assert abs(w - 2 / 8) < 1e-9
+
+
+def test_scheduler_eos_early_retirement_frees_blocks():
+    cfg, params = setup(max_seq_len=64)
+    prompt = np.arange(1, 10, dtype=np.int32)
+    first = int(greedy_reference(cfg, params, prompt, 1)[0])
+    s = Scheduler(cfg, params, n_slots=1, block_len=8, prefill_chunk=8,
+                  eos_id=first)
+    rid = s.submit(prompt, 10)
+    outs = s.drain()
+    assert outs[rid] == [first]  # retired after 1 of 10
+    assert s.metrics()["pool_blocks_in_use"] == 0
+
+
+def test_scheduler_submit_validation():
+    cfg, params = setup(max_seq_len=32)
+    s = Scheduler(cfg, params, n_slots=1, block_len=8, prefill_chunk=8)
+    with pytest.raises(ValueError, match="at least one token"):
+        s.submit(np.zeros((0,), np.int32), 2)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        s.submit(np.arange(1, 30, dtype=np.int32), 8)
+
+
+def test_engine_rejects_oversized_chunk_and_chain():
+    cfg, params = setup(max_seq_len=32)
+    eng = PagedEngine(cfg, params, n_slots=1, block_len=8, prefill_chunk=8)
+    with pytest.raises(ValueError, match="chunk"):
+        eng.run_chunks([ChunkJob(0, np.zeros(4, np.int32), 0, True, 0)])
+    with pytest.raises(ValueError, match="table width"):
+        eng.admit(0, 30, 30)  # needs > max_seq_len worth of blocks
+
+
+# ---------------------------------------------------------------------------
+# token parity + chunked prefill equivalence (slow tier, like test_serving)
+# ---------------------------------------------------------------------------
+
+
+def _drive_batcher(b, prompts, budgets):
+    got, slot_of, pending = {}, {}, list(range(len(prompts)))
+    while pending or any(b.remaining > 0):
+        while pending and b.free_slots():
+            i = pending.pop(0)
+            slot_of[i] = b.submit(prompts[i], budgets[i])
+            got[i] = []
+        for slot, token in b.step():
+            req = next(i for i, s in slot_of.items()
+                       if s == slot and len(got[i]) < budgets[i])
+            got[req].append(token)
+    return got
+
+
+@pytest.mark.slow
+def test_paged_batcher_matches_dense_continuous():
+    """Staggered admissions, slot reuse, mixed budgets: the paged engine
+    must emit token-identical greedy streams to the dense layout."""
+    cfg, params = setup()
+    rng = np.random.default_rng(1)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, (l,)).astype(np.int32)
+        for l in (7, 13, 4, 21)
+    ]
+    budgets = [6, 10, 8, 5]
+    dense = _drive_batcher(
+        ContinuousBatcher(cfg, params, n_slots=2, prefill_bucket=8,
+                          cache_layout="dense"),
+        prompts, budgets,
+    )
+    paged = _drive_batcher(
+        ContinuousBatcher(cfg, params, n_slots=2, prefill_bucket=8,
+                          cache_layout="paged"),
+        prompts, budgets,
+    )
+    assert dense == paged
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_paged_batcher_tp_matches_dense(kv_heads):
+    """TP=2 CPU mesh: the paged TP batcher (head-sharded block pool,
+    Megatron collectives inside the chunk/decode programs) matches the
+    replicated DENSE batcher token-for-token — and really is sharded."""
+    from pytorch_distributed_tpu.parallel import make_mesh
+
+    rep = tiny_config(attention="dense", max_seq_len=96, num_heads=4,
+                      num_kv_heads=kv_heads)
+    tpcfg = dataclasses.replace(rep, model_axis="model", tp_size=2)
+    params = TransformerLM(rep).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = make_mesh(jax.devices()[:2], data_parallel=1, seq_parallel=1,
+                     model_parallel=2)
+    rng = np.random.default_rng(2)
+    prompts = [
+        rng.integers(1, rep.vocab_size, (l,)).astype(np.int32)
+        for l in (5, 11, 7)
+    ]
+    budgets = [6, 6, 6]
+    dense_rep = _drive_batcher(
+        ContinuousBatcher(rep, params, n_slots=2, prefill_bucket=8,
+                          cache_layout="dense"),
+        prompts, budgets,
+    )
+    paged_tp = ContinuousBatcher(tpcfg, params, n_slots=2, prefill_bucket=8,
+                                 mesh=mesh, cache_layout="paged")
+    assert _drive_batcher(paged_tp, prompts, budgets) == dense_rep
+    # the pool really is head-sharded at rest
+    leaf = jax.tree.leaves(paged_tp.cache)[0]
+    assert next(iter(leaf.addressable_shards)).data.shape[2] == \
+        leaf.shape[2] // 2
+
+
+@pytest.mark.slow
+def test_chunked_prefill_matches_whole_prefill():
+    """A long prompt prefilled in 8-token chunks produces the same
+    first-token logits path (hence identical greedy tokens) as one-shot
+    prefill — the chunk boundary cannot change the math."""
+    cfg, params = setup()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, cfg.vocab_size, (29,)).astype(np.int32)
+    ref = greedy_reference(cfg, params, prompt, 8)
+    for bucket in (8, 16, 32):  # 4 chunks, 2 chunks, whole-prompt
+        b = ContinuousBatcher(cfg, params, n_slots=1,
+                              prefill_bucket=bucket)
+        slot = b.submit(prompt, 8)
+        got = []
+        while any(b.remaining > 0):
+            got += [t for _s, t in b.step()]
+        np.testing.assert_array_equal(
+            np.asarray(got, np.int32), ref, err_msg=f"bucket {bucket}"
+        )
+
+
+@pytest.mark.slow
+def test_scheduler_interleaves_long_prefill_with_decode():
+    """Chunked prefill is the point: while a LONG prompt prefills chunk
+    by chunk, an already-resident request keeps decoding every step (the
+    dense layout would have stalled it for the whole prefill)."""
+    cfg, params = setup(max_seq_len=96)
+    s = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=8,
+                  admit_per_step=1)
+    short = np.arange(1, 6, dtype=np.int32)
+    long = np.arange(1, 41, dtype=np.int32)  # 5 chunks of 8
+    produced = {}
+
+    def tick():
+        events = s.step()
+        for rid, tok in events:
+            produced.setdefault(rid, []).append(tok)
+        return dict(events)
+
+    r_short = s.submit(short, 12)
+    tick()  # short admitted + prefilled (1 chunk) + first token
+    r_long = s.submit(long, 2)
+    short_tokens_during_long_prefill = 0
+    for _ in range(5):  # the long prompt's 5 prefill-chunk steps
+        if r_short in tick():
+            short_tokens_during_long_prefill += 1
+    assert short_tokens_during_long_prefill == 5  # never stalled
+    for rid, toks in s.drain().items():
+        produced.setdefault(rid, []).extend(toks)
+    assert produced[r_short] == list(greedy_reference(cfg, params, short, 12))
+    assert produced[r_long] == list(greedy_reference(cfg, params, long, 2))

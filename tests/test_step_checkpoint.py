@@ -116,3 +116,25 @@ def test_interval_resume_bit_exact(tmp_path, devices8):
     assert int(jax.device_get(t_ref.state.step)) == int(
         jax.device_get(t_res.state.step)
     )
+
+
+def test_legacy_latest_ranked_by_real_step(tmp_path):
+    """ADVICE r5 #1: ``newest_restorable`` used to hardcode a legacy
+    single-file ``latest.ckpt`` to step 0, so a strictly-OLDER interval
+    checkpoint could win resume over a newer suspend save. The legacy
+    step is now read from the msgpack payload."""
+    from pytorch_distributed_tpu.utils.checkpoint import (
+        legacy_checkpoint_step,
+    )
+
+    d = str(tmp_path)
+    ck = Checkpointer(d)
+    ck.save_step_sharded(_payload(100), 100, keep_last=4, block=True)
+    ck.save_latest(_payload(1000))  # legacy single-file suspend save
+    assert legacy_checkpoint_step(ck.latest_path) == 1000
+    # the r5 bug: step-100 (sharded) would beat the step-1000 legacy file
+    assert ck.newest_restorable() == ck.latest_path
+    # and the ranking is by STEP, not by format: an older legacy file
+    # correctly loses to a newer interval checkpoint
+    ck.save_latest(_payload(50))
+    assert ck.newest_restorable().endswith("step-00000100.ckpt")
