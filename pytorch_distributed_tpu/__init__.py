@@ -19,6 +19,10 @@ single chip → multi-host pod):
   suspend/checkpoint/resume (ref: ``restnet_ddp.py:36-47,127-132``).
 - ``utils``    — env manifest pinning (ref: ``hf_env.set_env``), logging,
   profiling.
+- ``telemetry`` — the observability runtime: sync-free device metrics
+  ring, host span tracing, goodput ledger, latency percentiles (the
+  reference has only ``time.time()`` prints; ANALYSIS.md
+  "Observability & goodput").
 
 The reference's four scripts differ only in how replicas communicate; here
 that difference collapses into sharding specs on one trainer (SURVEY.md §7).

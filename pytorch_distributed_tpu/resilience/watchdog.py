@@ -62,6 +62,10 @@ class Watchdog:
                     a stall that no beat cleared — the hard-hang escape
                     hatch; the scheduler's relaunch resumes from the last
                     complete checkpoint.
+    ``ledger``      optional ``telemetry.GoodputLedger``: when a beat
+                    clears a fired stall, the whole beat-to-beat gap is
+                    classified as ``stall`` time (the step made no
+                    progress while the watchdog was screaming).
     """
 
     def __init__(
@@ -74,6 +78,7 @@ class Watchdog:
         exit_code: Optional[int] = None,
         grace_s: float = 10.0,
         poll_s: Optional[float] = None,
+        ledger=None,
     ):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
@@ -83,6 +88,7 @@ class Watchdog:
         self.on_stall = on_stall
         self.exit_code = exit_code
         self.grace_s = float(grace_s)
+        self.ledger = ledger
         self.poll_s = float(poll_s) if poll_s else min(
             1.0, self.timeout_s / 4.0
         )
@@ -120,8 +126,15 @@ class Watchdog:
 
     def beat(self) -> None:
         """One step completed; re-arm the deadline. Cheap: one clock read
-        and two attribute stores."""
-        self._last = time.monotonic()
+        and two attribute stores (plus a goodput attribution when this
+        beat clears a fired stall)."""
+        now = time.monotonic()
+        if self._fired and self.ledger is not None:
+            try:
+                self.ledger.add("stall", max(now - self._last, 0.0))
+            except Exception:
+                logger.exception("watchdog: goodput ledger rejected stall")
+        self._last = now
         self._armed = True
         self._fired = False
 

@@ -23,7 +23,16 @@ Metrics are exact host-side counters, no device sync beyond the token
 fetch the caller already pays: slot occupancy, block-pool occupancy,
 padding-waste fraction (allocated-but-unwritten block capacity),
 admission latency (steps and wall seconds from submit to admission),
-queue depth, and tokens/s.
+queue depth, and tokens/s — plus, from round 7 (ISSUE 4), the latency
+percentiles a continuous batcher exists to control: TTFT (submit →
+first materialized token), per-output-token latency (inter-token gap),
+and queue wait (submit → admit), all exact host-side series from
+timestamps the scheduler already holds (``telemetry.LatencySeries``).
+Pass ``metrics_log`` (a ``MetricsLogger``) to stream one ``kind=
+"request"`` JSONL record per retirement — the raw material
+``scripts/telemetry_report.py`` computes percentiles from — and
+``tracer`` (a ``telemetry.SpanTracer``) for admission / prefill_chunk /
+decode_tick spans.
 """
 
 from __future__ import annotations
@@ -35,6 +44,8 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from pytorch_distributed_tpu.telemetry import NULL_TRACER, LatencySeries
 
 
 @dataclasses.dataclass
@@ -49,6 +60,11 @@ class Request:
     produced: int = 0
     admit_step: int = -1
     admit_time: float = float("nan")
+    first_token_time: float = float("nan")
+    last_token_time: float = float("nan")
+    # inter-token gaps AFTER the first token (the decode-tick latency
+    # this request's stream observed; the first token's latency is TTFT)
+    token_gaps: List[float] = dataclasses.field(default_factory=list)
 
     @property
     def length(self) -> int:
@@ -67,7 +83,8 @@ class Scheduler:
                  n_blocks: Optional[int] = None, block_len: int = 16,
                  prefill_chunk: int = 64, admit_per_step: int = 4,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 seed: int = 0, eos_id: Optional[int] = None, mesh=None):
+                 seed: int = 0, eos_id: Optional[int] = None, mesh=None,
+                 tracer=None, metrics_log=None):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
 
         if eos_id is not None and not 0 <= eos_id < config.vocab_size:
@@ -102,6 +119,12 @@ class Scheduler:
         self._adm_latency_s = 0.0
         self._occupancy_sum = 0.0  # mean-able over steps
         self._start_time: Optional[float] = None
+        # ---- latency series (telemetry/latency.py; exact, host-side) ----
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics_log = metrics_log
+        self.ttft = LatencySeries("ttft")
+        self.token_lat = LatencySeries("token_lat")
+        self.queue_wait = LatencySeries("queue_wait")
 
     # ---- API ----
 
@@ -158,6 +181,7 @@ class Scheduler:
             self._admitted += 1
             self._adm_latency_steps += self._step_count - req.submit_step
             self._adm_latency_s += now - req.submit_time
+            self.queue_wait.observe(now - req.submit_time)
             admitted += 1
 
     def _chunk_jobs(self):
@@ -185,10 +209,12 @@ class Scheduler:
         retirements. Returns ``[(rid, token)]``."""
         if self._start_time is None:
             self._start_time = time.perf_counter()
-        self._admit()
+        with self.tracer.span("admission", queued=len(self.queue)):
+            self._admit()
         jobs = self._chunk_jobs()
         if jobs:
-            self.engine.run_chunks(jobs)
+            with self.tracer.span("prefill_chunk", jobs=len(jobs)):
+                self.engine.run_chunks(jobs)
             for j in jobs:
                 req = self.resident[j.slot]
                 req.prefill_done += self.engine.chunk
@@ -203,15 +229,27 @@ class Scheduler:
         if not active.any():
             return []
         self._rng, sub = jax.random.split(self._rng)
-        tokens, self.positions = self.engine.decode(
-            self.positions, active, sub
-        )
+        with self.tracer.span("decode_tick", lanes=int(active.sum())):
+            tokens, self.positions = self.engine.decode(
+                self.positions, active, sub
+            )
+        # engine.decode returns MATERIALIZED numpy tokens, so this
+        # timestamp is token-delivery time, not dispatch time
+        now = time.perf_counter()
         out: List[Tuple[int, int]] = []
         for slot in np.nonzero(active)[0]:
             slot = int(slot)
             req = self.resident[slot]
             token = int(tokens[slot])
             out.append((req.rid, token))
+            if req.produced == 0:
+                req.first_token_time = now
+                self.ttft.observe(now - req.submit_time)
+            else:
+                gap = now - req.last_token_time
+                req.token_gaps.append(gap)
+                self.token_lat.observe(gap)
+            req.last_token_time = now
             req.produced += 1
             self._tokens_out += 1
             if (self.eos_id is not None and token == self.eos_id) or \
@@ -220,9 +258,25 @@ class Scheduler:
                 del self.resident[slot]
                 self.engine.release(slot)
                 self._completed += 1
+                self._log_request(req)
             else:
                 self.remaining[slot] -= 1
         return out
+
+    def _log_request(self, req: Request) -> None:
+        """One ``kind="request"`` JSONL record per retirement — the raw
+        per-request latencies ``telemetry_report.py`` aggregates."""
+        if self.metrics_log is None:
+            return
+        self.metrics_log.log(
+            kind="request",
+            rid=req.rid,
+            prompt_len=req.length,
+            new_tokens=req.produced,
+            queue_wait_s=round(req.admit_time - req.submit_time, 6),
+            ttft_s=round(req.first_token_time - req.submit_time, 6),
+            token_gaps_s=[round(g, 6) for g in req.token_gaps],
+        )
 
     def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Step until queue and lanes are empty; returns
@@ -281,4 +335,8 @@ class Scheduler:
                 self._adm_latency_s / self._admitted
                 if self._admitted else 0.0
             ),
+            # latency percentiles — the SLO surface (exact, host-side)
+            **self.ttft.summary("ttft"),
+            **self.token_lat.summary("token_lat"),
+            **self.queue_wait.summary("queue_wait"),
         }

@@ -1,0 +1,49 @@
+"""Unified observability runtime: device metrics, spans, goodput, latency.
+
+The reference's entire observability story is rank-0 ``time.time()`` epoch
+prints with GPU util measured externally by the cluster (PAPER.md §5
+"tracing: ABSENT"); round 1 replaced the prints with a JSONL stream
+(``utils.profiling.MetricsLogger``) but left three holes this package
+closes:
+
+- ``device_metrics`` — a fixed-shape, donated on-device ring buffer the
+  trainers push each log-interval's metric scalars into, drained every
+  ``flush_every`` windows with ONE lagged host transfer. Replaces the
+  per-log-interval blocking ``float()`` sync that stalled the dispatch
+  pipeline in both trainers; the logged series is bit-identical to the
+  blocking path (same f32 scalars, one hop through the buffer).
+- ``spans`` — nested host-side span tracing (data_wait, step_dispatch,
+  ckpt_save, rollback_replay, admission, prefill_chunk, decode_tick)
+  emitted as Chrome-trace JSON and mirrored into
+  ``jax.profiler.TraceAnnotation`` so host phases line up with XLA op
+  timelines in xprof.
+- ``goodput`` — a run-level ledger classifying wall time into
+  productive-step vs compile, data wait, checkpoint stall, rollback
+  replay, and watchdog stall; fractions sum to 1 by construction.
+- ``latency`` — exact host-side latency series with percentile
+  summaries (TTFT, per-output-token, queue wait for the serving
+  scheduler).
+
+Everything reports through the one JSONL schema of
+``utils.profiling.MetricsLogger``; ``scripts/telemetry_report.py``
+renders a run's JSONL into the summary table ``bench.py`` consumes.
+ANALYSIS.md "Observability & goodput" documents the schema.
+"""
+
+from pytorch_distributed_tpu.telemetry.device_metrics import DeviceMetricsRing
+from pytorch_distributed_tpu.telemetry.goodput import (
+    GOODPUT_CATEGORIES,
+    GoodputLedger,
+)
+from pytorch_distributed_tpu.telemetry.latency import LatencySeries, percentiles
+from pytorch_distributed_tpu.telemetry.spans import NULL_TRACER, SpanTracer
+
+__all__ = [
+    "DeviceMetricsRing",
+    "GOODPUT_CATEGORIES",
+    "GoodputLedger",
+    "LatencySeries",
+    "percentiles",
+    "NULL_TRACER",
+    "SpanTracer",
+]

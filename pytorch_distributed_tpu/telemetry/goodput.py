@@ -1,0 +1,98 @@
+"""Goodput ledger: classify a run's wall time, emit the goodput fraction.
+
+The north-star metric every perf PR reports through: of the wall time a
+run consumed, what fraction went to productive training steps versus the
+overheads this repo has grown machinery for — XLA compilation, data
+wait, checkpoint stalls, rollback replay after the step guard condemned
+a run, and watchdog-detected stalls.
+
+Accounting model (host-side, exact by construction):
+
+- the trainers time each NON-productive phase as it happens
+  (``timed(category)`` around the blocking call; the watchdog feeds
+  ``stall`` from its heartbeat gap);
+- productive time is the REMAINDER: ``wall - sum(classified)``. Under
+  async dispatch the host is inside ``next(loader)`` or a drain sync
+  while the device trains, so host-side "time not lost to a known
+  overhead" is precisely the time the device had work to do;
+- fractions are normalized by ``max(wall, classified_sum)`` so they sum
+  to 1 even if overlapping attribution ever over-counts (categories are
+  disjoint in the trainers, so normally ``denominator == wall``).
+
+``report()`` is one flat dict — the ``kind="goodput"`` JSONL record
+``scripts/telemetry_report.py`` renders.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+#: The non-productive wall-time classes the trainers attribute.
+GOODPUT_CATEGORIES = (
+    "compile",
+    "data_wait",
+    "checkpoint",
+    "rollback",
+    "stall",
+)
+
+
+class GoodputLedger:
+    """Run-level wall-time classification.
+
+    ``start()`` pins the run clock (idempotent; ``timed``/``add`` call it
+    implicitly). ``add(category, s)`` attributes seconds; ``timed(cat)``
+    is the context-manager form. ``report()`` returns per-category
+    seconds + fractions + ``goodput_frac`` (the productive fraction).
+    """
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+        self._acc: Dict[str, float] = {c: 0.0 for c in GOODPUT_CATEGORIES}
+
+    def start(self) -> None:
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+
+    def add(self, category: str, seconds: float) -> None:
+        if category not in self._acc:
+            raise ValueError(
+                f"unknown goodput category {category!r}; "
+                f"expected one of {GOODPUT_CATEGORIES}"
+            )
+        if seconds < 0:
+            raise ValueError(f"negative duration {seconds!r}")
+        self.start()
+        self._acc[category] += float(seconds)
+
+    @contextlib.contextmanager
+    def timed(self, category: str) -> Iterator[None]:
+        self.start()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(category, time.perf_counter() - t0)
+
+    def seconds(self, category: str) -> float:
+        return self._acc[category]
+
+    def report(self) -> dict:
+        """Flat goodput record. ``productive_s`` is the unclassified
+        remainder; ``*_frac`` values (productive + every category) sum
+        to 1."""
+        wall = (
+            time.perf_counter() - self._t0 if self._t0 is not None else 0.0
+        )
+        classified = sum(self._acc.values())
+        denom = max(wall, classified) or 1.0
+        productive = max(wall - classified, 0.0)
+        out: dict = {"wall_s": wall, "productive_s": productive}
+        out["goodput_frac"] = productive / denom
+        out["productive_frac"] = out["goodput_frac"]
+        for cat in GOODPUT_CATEGORIES:
+            out[f"{cat}_s"] = self._acc[cat]
+            out[f"{cat}_frac"] = self._acc[cat] / denom
+        return out

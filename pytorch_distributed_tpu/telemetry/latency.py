@@ -1,0 +1,64 @@
+"""Exact host-side latency series with percentile summaries.
+
+The serving scheduler holds every timestamp a latency SLO needs — submit,
+admit, first token, per-token ticks — but round 6 reported only
+throughput. This module is the missing aggregation: append raw seconds,
+summarize with exact percentiles (``numpy.percentile``, linear
+interpolation — no bucketing error at demo scale; the series are
+host-side floats, never device work).
+
+Used for TTFT (submit → first materialized token), per-output-token
+latency (inter-token gap per stream), and queue wait (submit → admit).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+
+def percentiles(
+    values: Sequence[float], qs: Sequence[float] = (50, 95, 99)
+) -> Dict[str, float]:
+    """``{"p50": ..., "p95": ...}`` via numpy's linear interpolation;
+    empty input → empty dict."""
+    import numpy as np
+
+    vals = np.asarray(list(values), dtype=np.float64)
+    if vals.size == 0:
+        return {}
+    return {
+        f"p{q:g}": float(np.percentile(vals, q)) for q in qs
+    }
+
+
+class LatencySeries:
+    """Append-only series of seconds with a flat summary.
+
+    ``summary(prefix)`` → ``{prefix_count, prefix_mean_s, prefix_p50_s,
+    prefix_p95_s, prefix_p99_s, prefix_max_s}`` (empty series → counts
+    only), ready to merge into a metrics dict / JSONL record.
+    """
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.values: List[float] = []
+
+    def observe(self, seconds: float) -> None:
+        self.values.append(float(seconds))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def summary(self, prefix: str = "") -> dict:
+        import numpy as np
+
+        p = f"{prefix}_" if prefix else ""
+        out = {f"{p}count": len(self.values)}
+        if not self.values:
+            return out
+        vals = np.asarray(self.values, dtype=np.float64)
+        out[f"{p}mean_s"] = float(vals.mean())
+        out[f"{p}max_s"] = float(vals.max())
+        for q, v in percentiles(vals).items():
+            out[f"{p}{q}_s"] = v
+        return out

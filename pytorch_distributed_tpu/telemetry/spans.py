@@ -1,0 +1,118 @@
+"""Nested host-side span tracing → Chrome trace JSON + xprof annotations.
+
+Wall-clock phases of a run (data_wait, step_dispatch, ckpt_save,
+rollback_replay, admission, prefill_chunk, decode_tick) as nested spans:
+
+- collected host-side with ``time.perf_counter`` (microsecond Chrome
+  trace convention), one complete ("X") event per span, ``tid`` = the
+  recording thread — ``chrome://tracing`` / Perfetto load the output
+  directly;
+- mirrored into ``jax.profiler.TraceAnnotation`` when a jax profiler
+  trace is active, so the host phases line up with the XLA op/fusion
+  timelines in xprof (the reference has no tracing story at all,
+  PAPER.md §5).
+
+A disabled tracer (``NULL_TRACER``, the default everywhere) costs one
+truthiness check per span — components thread a tracer through without
+caring whether anyone is listening.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Iterator, List, Optional
+
+
+class SpanTracer:
+    """Collects nested spans; ``save()`` writes Chrome-trace JSON.
+
+    ``span(name, **args)`` is a context manager; spans may nest freely
+    (the Chrome trace format reconstructs the stack from containment per
+    ``tid``). Thread-safe: events append under a lock, ``tid`` is the
+    recording thread's ident.
+    """
+
+    def __init__(self, enabled: bool = True, mirror_jax: bool = True):
+        self.enabled = bool(enabled)
+        self.mirror_jax = bool(mirror_jax)
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        ctx = contextlib.nullcontext()
+        if self.mirror_jax:
+            try:
+                import jax
+
+                ctx = jax.profiler.TraceAnnotation(name)
+            except Exception:  # no jax / no profiler: host-only spans
+                ctx = contextlib.nullcontext()
+        t0 = self._now_us()
+        try:
+            with ctx:
+                yield
+        finally:
+            dur = self._now_us() - t0
+            ev = {
+                "name": name,
+                "ph": "X",
+                "ts": t0,
+                "dur": dur,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+            }
+            if args:
+                ev["args"] = args
+            with self._lock:
+                self._events.append(ev)
+
+    # ---- output ----------------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace dict: metadata + every completed span."""
+        with self._lock:
+            events = list(self._events)
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": os.getpid(),
+                "args": {"name": "pytorch_distributed_tpu host"},
+            }
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> str:
+        """Write the Chrome trace JSON to ``path`` (dirs created)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def events(self, name: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        if name is not None:
+            evs = [e for e in evs if e["name"] == name]
+        return evs
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+#: Shared no-op tracer: components default to it so span call sites never
+#: need a None check.
+NULL_TRACER = SpanTracer(enabled=False)

@@ -28,6 +28,11 @@ from pytorch_distributed_tpu.resilience.stepguard import (
     StepGuard,
 )
 from pytorch_distributed_tpu.resilience.watchdog import Watchdog
+from pytorch_distributed_tpu.telemetry import (
+    NULL_TRACER,
+    GoodputLedger,
+    SpanTracer,
+)
 from pytorch_distributed_tpu.utils.logging import rank0_print
 
 
@@ -38,6 +43,11 @@ class SuspendableTrainer:
     guard = None
     watchdog = None
     rollbacks = 0
+    # telemetry attributes; _init_resilience overrides them per config
+    goodput = None
+    tracer = NULL_TRACER
+    _ring = None
+    _dispatched = 0
 
     # ---- resilience plumbing (resilience/: stepguard, watchdog, faults).
     # Both trainers call _init_resilience from __init__ and bracket each
@@ -48,8 +58,15 @@ class SuspendableTrainer:
         """Build the step guard and watchdog the config asks for. The
         guard exists whenever the compiled step emits ``step_good``
         (``nan_guard=True``); ``max_bad_steps=0`` means skip-only, no
-        rollback."""
+        rollback. The goodput ledger and span tracer (telemetry/) are
+        built here too — the watchdog feeds the ledger its stall time."""
         cfg = self.config
+        self.goodput = GoodputLedger()
+        self.tracer = (
+            SpanTracer() if getattr(cfg, "trace_dir", None) else NULL_TRACER
+        )
+        self._ring = None  # built lazily from the first metrics dict
+        self._dispatched = 0  # run-level step-dispatch count (compile attr)
         if getattr(cfg, "nan_guard", False):
             self.guard = StepGuard(
                 max_bad_steps=getattr(cfg, "max_bad_steps", 0)
@@ -62,7 +79,51 @@ class SuspendableTrainer:
                 dump_path=os.path.join(cfg.save_dir, "watchdog_stall.log")
                 if jax.process_index() == 0
                 else None,
+                ledger=self.goodput,
             ).start()
+
+    # ---- telemetry plumbing (telemetry/: device ring, spans, goodput).
+    # The trainers push each log event's device metric scalars through
+    # _telemetry_append instead of blocking on float(); records drain
+    # lagged, one transfer per flush_every log events. ----
+
+    def _telemetry_append(self, metrics: dict, **meta) -> list:
+        """Push one log event into the device ring (no host sync);
+        returns any records the push drained."""
+        if self._ring is None:
+            from pytorch_distributed_tpu.telemetry import DeviceMetricsRing
+
+            self._ring = DeviceMetricsRing(
+                list(metrics),
+                capacity=max(getattr(self.config, "flush_every", 32), 1),
+                sharding=mesh_lib.replicated_sharding(self.mesh),
+            )
+        return self._ring.append(metrics, **meta)
+
+    def _telemetry_flush(self) -> list:
+        """Drain everything buffered (epoch end); may sync on the last
+        pushed step — the same point the epoch-timing record syncs."""
+        return self._ring.flush() if self._ring is not None else []
+
+    def _drain_train_records(self, records) -> dict:
+        """Emit drained ring records (subclass formats them); returns the
+        last record's metrics. Base default: nothing to emit."""
+        return {}
+
+    def _log_goodput(self) -> None:
+        """Emit the run-level goodput record (fit end / pre-suspend)."""
+        if self.goodput is not None and getattr(self, "metrics_log", None):
+            self.metrics_log.log(kind="goodput", **self.goodput.report())
+
+    def _save_traces(self) -> None:
+        """Write the span tracer's Chrome trace (rank 0, fit end)."""
+        trace_dir = getattr(self.config, "trace_dir", None)
+        if (
+            trace_dir
+            and self.tracer.enabled
+            and jax.process_index() == 0
+        ):
+            self.tracer.save(os.path.join(trace_dir, "spans.trace.json"))
 
     def _pre_step(self, host_batch):
         """Once per train step, before device dispatch: apply any
@@ -102,13 +163,18 @@ class SuspendableTrainer:
         a state the guard condemned would just NaN again."""
         self.rollbacks += 1
         rank0_print(f"stepguard: {err}; restoring last good checkpoint")
-        self.ckpt.wait()  # commit/join any in-flight save first
-        if not self.try_resume():
-            raise RuntimeError(
-                "stepguard requested rollback but no restorable checkpoint "
-                "exists — enable save_every_n_steps (or suspend saves) so "
-                "a rollback target is available"
-            ) from err
+        # surface the condemned run's buffered log events before the
+        # replay re-logs the same steps (keeps the JSONL ordered)
+        self._drain_train_records(self._telemetry_flush())
+        with self.goodput.timed("rollback"), \
+                self.tracer.span("rollback_replay"):
+            self.ckpt.wait()  # commit/join any in-flight save first
+            if not self.try_resume():
+                raise RuntimeError(
+                    "stepguard requested rollback but no restorable "
+                    "checkpoint exists — enable save_every_n_steps (or "
+                    "suspend saves) so a rollback target is available"
+                ) from err
         self.guard.reset()
 
     # ---- checkpoint payloads (collective: call on ALL ranks) ----
@@ -215,12 +281,14 @@ class SuspendableTrainer:
         every = getattr(self.config, "save_every_n_steps", 0)
         if every <= 0 or (step + 1) % every:  # negative = off, like 0
             return
-        gstep = int(np.asarray(jax.device_get(self.state.step)))
-        self.ckpt.save_step_sharded(
-            self._payload_live(epoch, step + 1), gstep,
-            keep_last=getattr(self.config, "keep_last_ckpts", 3),
-            block=False,
-        )
+        with self.goodput.timed("checkpoint"), \
+                self.tracer.span("ckpt_save", step=step):
+            gstep = int(np.asarray(jax.device_get(self.state.step)))
+            self.ckpt.save_step_sharded(
+                self._payload_live(epoch, step + 1), gstep,
+                keep_last=getattr(self.config, "keep_last_ckpts", 3),
+                block=False,
+            )
 
     # ---- the suspend agreement (ref restnet_ddp.py:36-47) ----
 
@@ -246,13 +314,24 @@ class SuspendableTrainer:
             )
         if not suspended:
             return
+        # the run is about to yield: surface the ring's buffered log
+        # events so the JSONL tail isn't lost with the process
+        self._drain_train_records(self._telemetry_flush())
         # Sharded save: EVERY process writes its own blocks (no gather, no
         # full-state host copy on any rank); rank 0 adds the manifest; the
         # save's internal barrier guarantees all files landed before yield.
-        self.ckpt.save_latest_sharded(self._payload_live(epoch, step + 1))
-        rank0_print(
-            f"suspend: saved {self.ckpt.latest_path} at epoch {epoch} "
-            f"step {step}"
-        )
-        self.ckpt.wait()
+        with self.goodput.timed("checkpoint"), \
+                self.tracer.span("ckpt_save", step=step, suspend=True):
+            self.ckpt.save_latest_sharded(
+                self._payload_live(epoch, step + 1)
+            )
+            rank0_print(
+                f"suspend: saved {self.ckpt.latest_path} at epoch {epoch} "
+                f"step {step}"
+            )
+            self.ckpt.wait()
+        # the run may not come back: record what this attempt's wall
+        # time went to before yielding
+        self._log_goodput()
+        self._save_traces()
         self.watcher.go_suspend()

@@ -138,6 +138,14 @@ class LMTrainerConfig:
     nan_guard: bool = False
     max_bad_steps: int = 0
     watchdog_timeout_s: float = 0.0
+    # Telemetry — see TrainerConfig: metrics_out overrides the JSONL
+    # path (rank-0 gated in MetricsLogger); flush_every sizes the
+    # on-device metrics ring (sync-free log path, drained lagged one
+    # transfer per window; 0 = legacy blocking float() per log
+    # interval); trace_dir writes the host span Chrome trace.
+    metrics_out: Optional[str] = None
+    trace_dir: Optional[str] = None
+    flush_every: int = 32
 
 
 class LMTrainer(SuspendableTrainer):
@@ -320,11 +328,12 @@ class LMTrainer(SuspendableTrainer):
         self.best_ppl = float("inf")
         self.start_epoch = 0
         self.start_step = 0
-        self._init_resilience()  # stepguard + watchdog per config
+        self._init_resilience()  # stepguard + watchdog + telemetry
+        self.ckpt.tracer = self.tracer  # ckpt snapshot/commit spans
+        # rank-0 gating lives inside MetricsLogger now
         self.metrics_log = MetricsLogger(
-            os.path.join(config.save_dir, "metrics.jsonl")
-            if jax.process_index() == 0
-            else None
+            config.metrics_out
+            or os.path.join(config.save_dir, "metrics.jsonl")
         )
 
     # ---- checkpoint contract: shared machinery in train/base.py ----
@@ -337,34 +346,78 @@ class LMTrainer(SuspendableTrainer):
 
     # ---- loops ----
 
+    def _emit_train_record(self, rec: dict) -> None:
+        """Print + JSONL one train log event — same arithmetic as the
+        legacy blocking path, so the two series are bit-identical."""
+        vals = {k: v for k, v in rec.items() if k not in ("epoch", "step")}
+        rank0_print(
+            f"epoch {rec['epoch']} step {rec['step']}: "
+            f"loss {rec['loss']:.4f}"
+        )
+        self.metrics_log.log(
+            kind="train", epoch=rec["epoch"], step=rec["step"], **vals
+        )
+
+    def _drain_train_records(self, records) -> dict:
+        last: dict = {}
+        for rec in records:
+            self._emit_train_record(rec)
+            last = {
+                k: v for k, v in rec.items() if k not in ("epoch", "step")
+            }
+        return last
+
     def train_epoch(self, epoch: int, start_step: int = 0) -> dict:
         cfg = self.config
         last: dict = {}
         t0 = time.perf_counter()
         steps_done = 0
-        tokens_per_step = None
-        for step, host_batch in enumerate(
+        it = enumerate(
             self.train_loader.iter_batches(start_step), start=start_step
-        ):
+        )
+        while True:
+            with self.goodput.timed("data_wait"), \
+                    self.tracer.span("data_wait"):
+                pair = next(it, None)
+            if pair is None:
+                break
+            step, host_batch = pair
             host_batch = self._pre_step(host_batch)
             batch = shard_lm_batch(
                 self.mesh, host_batch,
                 layout=self.model_config.ring_layout,
             )
-            self.state, metrics = self.train_step(self.state, batch)
+            td = time.perf_counter()
+            with self.tracer.span("step_dispatch", step=step):
+                self.state, metrics = self.train_step(self.state, batch)
+            if self._dispatched == 0:
+                # the run's first dispatch traces + compiles the step
+                self.goodput.add("compile", time.perf_counter() - td)
+            self._dispatched += 1
             self._post_step(metrics)
             steps_done += 1
             if cfg.log_every and step % cfg.log_every == 0:
-                last = {k: float(v) for k, v in metrics.items()}
-                tokens_per_step = last["tokens"]
-                rank0_print(
-                    f"epoch {epoch} step {step}: loss {last['loss']:.4f}"
-                )
-                self.metrics_log.log(kind="train", epoch=epoch, step=step,
-                                     **last)
+                if cfg.flush_every > 0:
+                    # sync-free: push the replicated scalars into the
+                    # device ring; records drain lagged, one transfer
+                    # per flush_every log events
+                    last = self._drain_train_records(
+                        self._telemetry_append(
+                            metrics, epoch=epoch, step=step
+                        )
+                    ) or last
+                else:
+                    # legacy blocking path (flush_every=0): float()
+                    # syncs the dispatch pipeline at every log interval
+                    last = {k: float(v) for k, v in metrics.items()}
+                    self._emit_train_record(
+                        dict(last, epoch=epoch, step=step)
+                    )
             self._maybe_save_step(epoch, step)
             self._maybe_suspend(epoch, step)
         self._epoch_end_guard()  # drain the guard's lag window
+        last = self._drain_train_records(self._telemetry_flush()) or last
+        tokens_per_step = last.get("tokens")
         if steps_done:
             float(self.state.step)  # drain async dispatch before the clock
             elapsed = time.perf_counter() - t0
@@ -430,6 +483,7 @@ class LMTrainer(SuspendableTrainer):
             RollbackRequested,
         )
 
+        self.goodput.start()
         self.try_resume()
         summary: dict = {}
         epoch = self.start_epoch
@@ -446,7 +500,9 @@ class LMTrainer(SuspendableTrainer):
             # commit last epoch's pending best-save: its file write
             # overlapped this epoch's training; all ranks reach this point
             # together, so the commit barrier is safely ordered
-            self.ckpt.wait()
+            with self.goodput.timed("checkpoint"), \
+                    self.tracer.span("ckpt_save", commit=True):
+                self.ckpt.wait()
             summary = self.validate()
             rank0_print(
                 f"epoch {epoch}: val loss {summary['loss']:.4f} "
@@ -459,16 +515,21 @@ class LMTrainer(SuspendableTrainer):
                 # (barrier + manifest) lands at the next wait() — a point
                 # every rank reaches in the same order because the psum'd
                 # ppl gives all ranks the same improvement decision
-                self.ckpt.save_best_sharded(
-                    self._payload_live(epoch + 1, 0), block=False
-                )
+                with self.goodput.timed("checkpoint"), \
+                        self.tracer.span("ckpt_save", best=True):
+                    self.ckpt.save_best_sharded(
+                        self._payload_live(epoch + 1, 0), block=False
+                    )
                 rank0_print(f"new best ppl {self.best_ppl:.3f}, saved best.ckpt")
             self.metrics_log.log(kind="val", epoch=epoch,
                                  epoch_s=time.time() - t0, **summary)
             epoch += 1
-        self.ckpt.wait()  # commit any pending best-save before returning
+        with self.goodput.timed("checkpoint"):
+            self.ckpt.wait()  # commit any pending best-save before return
         if self.watchdog is not None:
             self.watchdog.stop()
+        self._log_goodput()
+        self._save_traces()
         self.start_step = 0
         summary["best_ppl"] = self.best_ppl
         return summary

@@ -94,6 +94,18 @@ class TrainerConfig:
     nan_guard: bool = False
     max_bad_steps: int = 0
     watchdog_timeout_s: float = 0.0
+    # Telemetry (telemetry/, ANALYSIS.md "Observability & goodput"):
+    # metrics_out overrides the JSONL stream path (default
+    # <save_dir>/metrics.jsonl; rank-0 gating lives inside MetricsLogger);
+    # flush_every sizes the on-device metrics ring — log-interval metric
+    # scalars are pushed by a donated compiled program and drained with
+    # ONE lagged host transfer per window, so logging never stalls the
+    # dispatch pipeline (0 = the legacy blocking float() sync, kept for
+    # bit-identity A/B); trace_dir writes the host span Chrome trace
+    # (spans.trace.json — data_wait/step_dispatch/ckpt_save/...).
+    metrics_out: Optional[str] = None
+    trace_dir: Optional[str] = None
+    flush_every: int = 32
 
 
 class Trainer(SuspendableTrainer):
@@ -200,14 +212,15 @@ class Trainer(SuspendableTrainer):
         self.best_acc = 0.0
         self.start_epoch = 0
         self.start_step = 0
-        self._init_resilience()  # stepguard + watchdog per config
+        self._init_resilience()  # stepguard + watchdog + telemetry
+        self.ckpt.tracer = self.tracer  # ckpt snapshot/commit spans
 
         # Observability (SURVEY.md §5: the reference has only time.time()
         # prints; we keep those AND stream machine-readable metrics).
+        # Rank-0 gating lives inside MetricsLogger now.
         self.metrics_log = MetricsLogger(
-            os.path.join(config.save_dir, "metrics.jsonl")
-            if jax.process_index() == 0
-            else None
+            config.metrics_out
+            or os.path.join(config.save_dir, "metrics.jsonl")
         )
 
     # ---- checkpoint contract (SURVEY.md §3.5): shared machinery in
@@ -223,6 +236,29 @@ class Trainer(SuspendableTrainer):
 
     # ---- the loops ----
 
+    def _emit_train_record(self, rec: dict) -> None:
+        """Print + JSONL one train log event (``rec`` carries the metric
+        floats plus epoch/step). Same arithmetic as the legacy blocking
+        path, so the two paths' series are bit-identical."""
+        acc1 = 100.0 * rec["correct1"] / max(rec["count"], 1)
+        rank0_print(
+            f"epoch {rec['epoch']} step {rec['step']}: "
+            f"loss {rec['loss']:.4f} acc1 {acc1:.2f}"
+        )
+        self.metrics_log.log(
+            kind="train", epoch=rec["epoch"], step=rec["step"],
+            loss=rec["loss"], acc1=acc1,
+        )
+
+    def _drain_train_records(self, records) -> dict:
+        last: dict = {}
+        for rec in records:
+            self._emit_train_record(rec)
+            last = {
+                k: v for k, v in rec.items() if k not in ("epoch", "step")
+            }
+        return last
+
     def train_epoch(self, epoch: int, start_step: int = 0) -> dict:
         """One training epoch (ref ``train``, ``restnet_ddp.py:19-47``)."""
         cfg = self.config
@@ -230,28 +266,48 @@ class Trainer(SuspendableTrainer):
         global_bs = mesh_lib.global_batch_size(self.mesh, cfg.batch_size)
         t0 = time.perf_counter()
         steps_done = 0
-        for step, host_batch in enumerate(
+        it = enumerate(
             self.train_loader.iter_batches(start_step), start=start_step
-        ):
+        )
+        while True:
+            with self.goodput.timed("data_wait"), \
+                    self.tracer.span("data_wait"):
+                pair = next(it, None)
+            if pair is None:
+                break
+            step, host_batch = pair
             host_batch = self._pre_step(host_batch)
             batch = mesh_lib.shard_batch(self.mesh, host_batch)
-            self.state, metrics = self.train_step(self.state, batch)
+            td = time.perf_counter()
+            with self.tracer.span("step_dispatch", step=step):
+                self.state, metrics = self.train_step(self.state, batch)
+            if self._dispatched == 0:
+                # the run's first dispatch traces + compiles the step;
+                # later recompiles are a guarded hazard, not steady state
+                self.goodput.add("compile", time.perf_counter() - td)
+            self._dispatched += 1
             self._post_step(metrics)
             steps_done += 1
             if cfg.log_every and step % cfg.log_every == 0:
-                last = {k: float(v) for k, v in metrics.items()}
-                acc1 = 100.0 * last["correct1"] / max(last["count"], 1)
-                rank0_print(
-                    f"epoch {epoch} step {step}: loss {last['loss']:.4f} "
-                    f"acc1 {acc1:.2f}"
-                )
-                self.metrics_log.log(
-                    kind="train", epoch=epoch, step=step, loss=last["loss"],
-                    acc1=acc1,
-                )
+                if cfg.flush_every > 0:
+                    # sync-free: push the device scalars into the ring;
+                    # records surface lagged, one transfer per window
+                    last = self._drain_train_records(
+                        self._telemetry_append(
+                            metrics, epoch=epoch, step=step
+                        )
+                    ) or last
+                else:
+                    # legacy blocking path (flush_every=0): float() syncs
+                    # the dispatch pipeline at every log interval
+                    last = {k: float(v) for k, v in metrics.items()}
+                    self._emit_train_record(
+                        dict(last, epoch=epoch, step=step)
+                    )
             self._maybe_save_step(epoch, step)
             self._maybe_suspend(epoch, step)
         self._epoch_end_guard()  # drain the guard's lag window
+        last = self._drain_train_records(self._telemetry_flush()) or last
         if steps_done:
             # Drain the async dispatch queue with a value fetch before
             # reading the clock — per-step host timestamps would measure
@@ -305,6 +361,7 @@ class Trainer(SuspendableTrainer):
             RollbackRequested,
         )
 
+        self.goodput.start()
         self.try_resume()
         summary: dict = {}
         first_epoch = self.start_epoch  # trace only the first epoch run
@@ -327,7 +384,9 @@ class Trainer(SuspendableTrainer):
             # commit last epoch's pending best-save: its file write
             # overlapped this epoch's training; all ranks reach this point
             # together, so the commit barrier is safely ordered
-            self.ckpt.wait()
+            with self.goodput.timed("checkpoint"), \
+                    self.tracer.span("ckpt_save", commit=True):
+                self.ckpt.wait()
             summary = self.validate()
             rank0_print(
                 f"epoch {epoch}: val loss {summary['loss']:.4f} "
@@ -340,9 +399,11 @@ class Trainer(SuspendableTrainer):
                 # (barrier + manifest) lands at the next wait() — a point
                 # every rank reaches in the same order because the psum'd
                 # acc gives all ranks the same improvement decision
-                self.ckpt.save_best_sharded(
-                    self._payload_live(epoch + 1, 0), block=False
-                )
+                with self.goodput.timed("checkpoint"), \
+                        self.tracer.span("ckpt_save", best=True):
+                    self.ckpt.save_best_sharded(
+                        self._payload_live(epoch + 1, 0), block=False
+                    )
                 rank0_print(f"new best acc1 {self.best_acc:.2f}, saved best.ckpt")
             epoch_s = time.time() - t0
             rank0_print(
@@ -352,9 +413,12 @@ class Trainer(SuspendableTrainer):
                 kind="val", epoch=epoch, epoch_s=epoch_s, **summary
             )
             epoch += 1
-        self.ckpt.wait()  # commit any pending best-save before returning
+        with self.goodput.timed("checkpoint"):
+            self.ckpt.wait()  # commit any pending best-save before return
         if self.watchdog is not None:
             self.watchdog.stop()
+        self._log_goodput()
+        self._save_traces()
         self.start_step = 0
         summary["best_acc"] = self.best_acc
         return summary

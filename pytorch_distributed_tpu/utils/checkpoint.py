@@ -873,12 +873,18 @@ class Checkpointer:
     """
 
     def __init__(self, save_dir: str | os.PathLike):
+        from pytorch_distributed_tpu.telemetry import NULL_TRACER
+
         self.save_dir = os.fspath(save_dir)
         self._thread: Optional[threading.Thread] = None
         self._pending: Optional[_ShardedSave] = None
         self._arena = _Arena()  # snapshot pages reused across saves
         self._warm_thread: Optional[threading.Thread] = None
         self._step_keep: Optional[int] = None  # GC request, runs at wait()
+        # span hook (telemetry/spans.py): trainers point this at their
+        # tracer so snapshot/commit phases show up in the Chrome trace
+        # next to data_wait/step_dispatch; default no-op
+        self.tracer = NULL_TRACER
 
     def _path(self, name: str) -> str:
         return os.path.join(self.save_dir, name)
@@ -961,12 +967,14 @@ class Checkpointer:
         if block:
             # blocking: stream from the live buffers — no snapshot copy,
             # no arena (the caller waits, so donation can't race)
-            s = _ShardedSave(path, payload, snapshot=False)
-            s.write()
-            s.finalize()
+            with self.tracer.span("ckpt_write", blocking=True):
+                s = _ShardedSave(path, payload, snapshot=False)
+                s.write()
+                s.finalize()
         else:
             # snapshot only (fast: bulk copy into the reused arena)
-            s = _ShardedSave(path, payload, arena=self._arena)
+            with self.tracer.span("ckpt_snapshot"):
+                s = _ShardedSave(path, payload, arena=self._arena)
             s.start()  # file write on a thread
             self._pending = s  # commit deferred to wait()
 
@@ -1142,9 +1150,11 @@ class Checkpointer:
             self._warm_thread.join()  # never race a save into the arena
             self._warm_thread = None
         if self._thread is not None:
-            self._thread.join()
+            with self.tracer.span("ckpt_commit_wait"):
+                self._thread.join()
             self._thread = None
         if self._pending is not None:
             pending, self._pending = self._pending, None
-            pending.finalize()
+            with self.tracer.span("ckpt_commit"):
+                pending.finalize()
         self._gc_steps()  # retention only after the new manifest landed

@@ -19,6 +19,7 @@ cluster). This module is the in-framework replacement:
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import json
 import os
@@ -111,26 +112,12 @@ def _scalar_sync(tree) -> None:
     np.asarray(jax.device_get(leaf))
 
 
-def trace_device_busy_s(trace_dir: str):
-    """Device-busy and device-active-span seconds from a ``jax.profiler``
-    trace.
-
-    Parses the Chrome-trace JSON the profiler writes, takes every complete
-    ("X") event on a device-named process track, and returns
-    ``(busy, span)``: the length of the union of their time intervals
-    (events nest, so summing durations would double-count) and the
-    first-event-start → last-event-end span. Returns None if no
-    trace/device events are found.
-    """
-    import glob
+def _file_busy_span_us(path: str):
+    """(busy, span) microseconds for ONE profiler trace file, or None if
+    it carries no device-track events."""
     import gzip
 
-    paths = sorted(
-        glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz"))
-    )
-    if not paths:
-        return None
-    with gzip.open(paths[-1], "rt") as f:
+    with gzip.open(path, "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", [])
     pids = {}
@@ -157,6 +144,39 @@ def trace_device_busy_s(trace_dir: str):
             cur_end = max(cur_end, end)
     busy += cur_end - cur_start
     span = max(end for _, end in intervals) - intervals[0][0]
+    return busy, span
+
+
+def trace_device_busy_s(trace_dir: str):
+    """Device-busy and device-active-span seconds from the
+    ``jax.profiler`` traces under ``trace_dir``.
+
+    Parses the Chrome-trace JSON the profiler writes, takes every
+    complete ("X") event on a device-named process track, and returns
+    ``(busy, span)``: the length of the union of their time intervals
+    (events nest, so summing durations would double-count) and the
+    first-event-start → last-event-end span. A directory holding
+    SEVERAL profiler runs (``plugins/profile/<run>/``) aggregates across
+    all of them — per-run busy and span summed — instead of the old
+    behavior of silently reading only the lexicographically newest run.
+    Returns None if no trace/device events are found anywhere.
+    """
+    import glob
+
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz"))
+    )
+    busy = span = 0.0
+    found = False
+    for path in paths:
+        bs = _file_busy_span_us(path)
+        if bs is None:
+            continue
+        found = True
+        busy += bs[0]
+        span += bs[1]
+    if not found:
+        return None
     # trace timestamps are microseconds
     return busy / 1e6, span / 1e6
 
@@ -199,16 +219,34 @@ def device_duty_cycle(step_fn, carry, *args, iters: int = 10) -> float:
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics (rank-0-gated by the caller, like every
-    reference print)."""
+    """Append-only JSONL metrics stream — the one schema every telemetry
+    producer (trainers, serving scheduler, goodput ledger) writes.
 
-    def __init__(self, path: Optional[str]):
+    Hardened per ISSUE 4: rank-0 gating lives INSIDE the class (callers
+    used to have to remember it; ``rank0_only=False`` opts out for
+    per-process streams), the file handle is registered with ``atexit``
+    so a crash mid-run flushes the tail instead of losing it, reopening
+    a path APPENDS (mode "a" — a resumed run extends its history), and
+    the logger is a context manager. Line-buffered writes: every record
+    is durable as soon as ``log`` returns.
+    """
+
+    def __init__(self, path: Optional[str], rank0_only: bool = True):
         self.path = path
-        if path:
+        self._f = None
+        if path and (not rank0_only or self._is_rank0()):
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._f = open(path, "a", buffering=1)
-        else:
-            self._f = None
+            atexit.register(self.close)
+
+    @staticmethod
+    def _is_rank0() -> bool:
+        try:
+            import jax
+
+            return jax.process_index() == 0
+        except Exception:  # no jax / uninitialized backend: single process
+            return True
 
     def log(self, **record) -> None:
         if self._f is None:
@@ -218,5 +256,15 @@ class MetricsLogger:
 
     def close(self) -> None:
         if self._f is not None:
+            try:
+                atexit.unregister(self.close)
+            except Exception:
+                pass
             self._f.close()
             self._f = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

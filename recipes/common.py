@@ -78,6 +78,28 @@ def _base_parser(description: str, save_dir: str,
                         "watchdog dumps all-thread stacks and latches "
                         "the suspend (checkpoint-and-yield) path "
                         "(0 = off)")
+    # Telemetry (telemetry/; ANALYSIS.md "Observability & goodput").
+    # Example — sync-free metrics + spans + a goodput report:
+    #   python recipes/lm_pretrain.py --tiny --flush-every 8 \
+    #       --metrics-out run.jsonl --trace-dir traces/
+    #   python scripts/telemetry_report.py run.jsonl
+    p.add_argument("--metrics-out", default=None,
+                   help="JSONL metrics stream path (default "
+                        "<save-dir>/metrics.jsonl; rank-0 only). Render "
+                        "with scripts/telemetry_report.py — train series, "
+                        "epoch timing, and the run's goodput breakdown")
+    p.add_argument("--trace-dir", default=None,
+                   help="write the host span Chrome trace (data_wait/"
+                        "step_dispatch/ckpt_save/...) to "
+                        "<dir>/spans.trace.json; spans also mirror into "
+                        "jax.profiler annotations when PDT_TRACE_DIR "
+                        "captures an xprof trace")
+    p.add_argument("--flush-every", type=int, default=32,
+                   help="device metrics ring window: log-interval metric "
+                        "scalars accumulate on device and drain with one "
+                        "lagged transfer per window — logging never "
+                        "blocks the dispatch pipeline (0 = legacy "
+                        "blocking float() sync per log interval)")
     return p
 
 
@@ -165,6 +187,9 @@ def run(args, mesh, precision: str = "fp32") -> dict:
         nan_guard=args.nan_guard,
         max_bad_steps=args.max_bad_steps,
         watchdog_timeout_s=args.watchdog_timeout,
+        metrics_out=args.metrics_out,
+        trace_dir=args.trace_dir,
+        flush_every=args.flush_every,
     )
     trainer = Trainer(
         model,

@@ -180,6 +180,9 @@ def main() -> None:
         nan_guard=args.nan_guard,
         max_bad_steps=args.max_bad_steps,
         watchdog_timeout_s=args.watchdog_timeout,
+        metrics_out=args.metrics_out,
+        trace_dir=args.trace_dir,
+        flush_every=args.flush_every,
     )
     trainer = LMTrainer(model_cfg, train_ds, val_ds, cfg, mesh=mesh,
                         suspend_watcher=SuspendWatcher())

@@ -14,6 +14,14 @@ queue depth, tokens/s). Zero required args; CPU-runnable:
 ``ContinuousBatcher`` layout (one max_seq_len KV row per slot, admission
 copying the full row) for an on-box A/B of the admission tax the paged
 engine removes; ANALYSIS.md "Serving engine" documents the design.
+
+Telemetry (round 7; ANALYSIS.md "Observability & goodput"):
+``--metrics-out serve.jsonl`` streams one ``kind="request"`` record per
+retirement (queue wait, TTFT, inter-token gaps) plus a final
+``kind="serving_summary"`` with the scheduler's percentile metrics —
+feed it to ``scripts/telemetry_report.py`` for TTFT/per-token p50/p95;
+``--trace-dir DIR`` writes the host span Chrome trace
+(admission/prefill_chunk/decode_tick) to ``DIR/spans.trace.json``.
 """
 
 from common import parse_args  # noqa: F401  (bootstraps sys.path)
@@ -61,6 +69,14 @@ def _parse() -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dense", action="store_true",
                    help="run the r4 dense layout instead (A/B reference)")
+    p.add_argument("--metrics-out", default=None,
+                   help="JSONL telemetry stream: per-request latency "
+                        "records + a serving_summary (read with "
+                        "scripts/telemetry_report.py)")
+    p.add_argument("--trace-dir", default=None,
+                   help="write the host span Chrome trace "
+                        "(admission/prefill_chunk/decode_tick) to "
+                        "<dir>/spans.trace.json")
     return p.parse_args()
 
 
@@ -90,6 +106,11 @@ def main() -> None:
     args = _parse()
     cfg, params = _model(args)
     prompts = _prompts(args, cfg)
+    from pytorch_distributed_tpu.telemetry import NULL_TRACER, SpanTracer
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    tracer = SpanTracer() if args.trace_dir else NULL_TRACER
+    mlog = MetricsLogger(args.metrics_out)
     t0 = time.perf_counter()
     if args.dense:
         # r4 layout: no queue — submit when a slot frees, the admission
@@ -110,6 +131,7 @@ def main() -> None:
             cfg, params, n_slots=args.slots, block_len=args.block_len,
             prefill_chunk=args.prefill_chunk,
             admit_per_step=args.admit_per_step, seed=args.seed,
+            tracer=tracer, metrics_log=mlog,
         )
         for p in prompts:
             s.submit(p, args.max_new)
@@ -117,6 +139,12 @@ def main() -> None:
         metrics = {"layout": "paged", **s.metrics()}
         assert len(streams) == args.requests
     metrics["wall_s"] = round(time.perf_counter() - t0, 2)
+    mlog.log(kind="serving_summary", **metrics)
+    mlog.close()
+    if args.trace_dir:
+        import os
+
+        tracer.save(os.path.join(args.trace_dir, "spans.trace.json"))
     rank0_print(json.dumps(metrics, indent=2))
 
 

@@ -10,6 +10,7 @@ in [16, 256] right-padded to 256, greedy. Measures:
   sync, round-trip subtracted).
 
 Usage: python scripts/bench_serving.py [--slots 32]
+       python scripts/bench_serving.py --paged-latency   # TTFT/token p50/p95
 """
 
 from __future__ import annotations
@@ -262,6 +263,46 @@ def measure_paged_admission(slots: int = 32, n: int = 10,
     return out
 
 
+def measure_paged_latency(slots: int = 16, requests: int = 48,
+                          max_new: int = 32) -> dict:
+    """End-to-end latency percentiles of the paged scheduler under a
+    queued multi-tenant workload (ISSUE 4: the one metric a
+    vLLM/Orca-style continuous batcher exists to control, previously
+    unreported). Drives ``serving.Scheduler`` with ``requests`` random
+    prompts (3x oversubscribed vs ``slots``), exact host-side TTFT /
+    per-output-token / queue-wait series from the scheduler's own
+    timestamps — no extra syncs beyond the token fetch every tick
+    already pays."""
+    from pytorch_distributed_tpu.serving import Scheduler
+
+    cfg, params = _gpt2_model()
+    rng = np.random.default_rng(0)
+    sched = Scheduler(cfg, params, n_slots=slots, prefill_chunk=64,
+                      admit_per_step=4)
+    lens = rng.integers(16, 257, requests)
+    for l in lens:
+        sched.submit(
+            rng.integers(1, cfg.vocab_size, size=int(l)).astype(np.int32),
+            max_new,
+        )
+    sched.drain()
+    m = sched.metrics()
+    out = {
+        "serving_paged_lat_slots": slots,
+        "serving_paged_lat_requests": requests,
+        "serving_paged_lat_max_new": max_new,
+        "serving_paged_tokens_per_s": round(m["tokens_per_s"], 1),
+    }
+    for name in ("ttft", "token_lat", "queue_wait"):
+        for q in ("p50", "p95"):
+            key = f"{name}_{q}_s"
+            if key in m:
+                out[f"serving_paged_{name}_{q}_ms"] = round(
+                    m[key] * 1e3, 2
+                )
+    return out
+
+
 def measure_tp_virtual(slots: int = 8, tp: int = 2) -> dict:
     """TP batcher decode rate on the VIRTUAL CPU mesh — a functionality
     row, not a performance claim (tp>1 needs more chips than this
@@ -309,6 +350,9 @@ def main() -> None:
         return
     if "--paged-stall" in sys.argv:
         print(json.dumps(measure_paged_admission(slots)))
+        return
+    if "--paged-latency" in sys.argv:
+        print(json.dumps(measure_paged_latency()))
         return
     if "--tp-virtual" in sys.argv:
         print(json.dumps(measure_tp_virtual()))

@@ -5,7 +5,8 @@
 # nor recorded with a reason in scripts/jaxlint_baseline.json — so NEW
 # hazards fail the build while the reviewed pre-existing ones don't.
 #
-# Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke|--serving-smoke]
+# Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke|--serving-smoke|
+#                             --telemetry-smoke]
 #
 # --resilience-smoke: lint, then ONE crash-recovery cycle from the
 # kill-matrix (SIGKILL mid-shard-write → relaunch → assert resume) —
@@ -16,6 +17,12 @@
 # cycle (tests/test_paged_serving.py::test_serving_smoke) — the cheap
 # end-to-end proof the paged serving path still admits, decodes, and
 # returns its blocks, without the parity/TP tier.
+#
+# --telemetry-smoke: lint, then one short LM training run and one
+# paged-serving cycle with --metrics-out, then telemetry_report.py must
+# parse BOTH JSONLs and print a goodput breakdown + TTFT/per-token
+# p50/p95 (it exits non-zero otherwise) — the end-to-end proof the
+# observability pipeline (device ring → JSONL → report) still closes.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -39,6 +46,25 @@ if [[ "${1:-}" == "--serving-smoke" ]]; then
     JAX_PLATFORMS=cpu python -m pytest \
         tests/test_paged_serving.py::test_serving_smoke -q \
         -p no:cacheprovider -p no:xdist -p no:randomly
+    exit 0
+fi
+
+if [[ "${1:-}" == "--telemetry-smoke" ]]; then
+    echo "== telemetry smoke (train + serve → JSONL → report) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    # the tiny LM recipe needs the 8 virtual CPU devices its docstring
+    # prescribes (dp2 × sp2 × tp1 by default)
+    JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        python recipes/lm_pretrain.py --tiny --epochs 1 \
+        --save-dir "$smoke/lm" --metrics-out "$smoke/lm.jsonl" \
+        --flush-every 4 --trace-dir "$smoke/traces"
+    JAX_PLATFORMS=cpu python recipes/serve_lm.py --tiny --requests 6 \
+        --slots 4 --max-new 8 --metrics-out "$smoke/serve.jsonl"
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/lm.jsonl" "$smoke/serve.jsonl" --json \
+        --require goodput,serving
     exit 0
 fi
 

@@ -1,0 +1,213 @@
+"""Render telemetry JSONL into the summary table bench.py consumes.
+
+Reads one or more ``MetricsLogger`` JSONL streams (a training run's
+``metrics.jsonl``, a serving run's ``--metrics-out`` file, or both) and
+produces, from the JSONL alone:
+
+- the **goodput breakdown** of a training run — productive / compile /
+  data-wait / checkpoint / rollback / stall fractions (summing to 1)
+  from the ``kind="goodput"`` record, plus the train-series shape
+  (steps logged, final loss) and epoch timing;
+- **serving latency percentiles** — TTFT and per-output-token p50/p95
+  (and queue wait) recomputed exactly from the per-request
+  ``kind="request"`` records (falling back to the
+  ``kind="serving_summary"`` percentiles when only the summary was
+  kept).
+
+Usage:
+    python scripts/telemetry_report.py RUN.jsonl [SERVE.jsonl ...] [--json]
+
+Human-readable tables by default; ``--json`` appends one flat JSON dict
+(bench.py record style) as the last line. Exits non-zero if NO goodput
+record and NO serving latencies were found — the ci_check.sh
+``--telemetry-smoke`` gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from pytorch_distributed_tpu.telemetry.goodput import (  # noqa: E402
+    GOODPUT_CATEGORIES,
+)
+from pytorch_distributed_tpu.telemetry.latency import (  # noqa: E402
+    percentiles,
+)
+
+
+def load_records(paths: List[str]) -> List[dict]:
+    records = []
+    for path in paths:
+        with open(path) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    raise SystemExit(
+                        f"{path}:{i + 1}: not JSONL ({e})"
+                    ) from e
+    return records
+
+
+def _fmt_row(label: str, *cells) -> str:
+    return "  " + label.ljust(20) + "".join(str(c).rjust(16) for c in cells)
+
+
+def goodput_section(records: List[dict], out: dict) -> List[str]:
+    """Goodput breakdown from the newest ``kind="goodput"`` record."""
+    gps = [r for r in records if r.get("kind") == "goodput"]
+    if not gps:
+        return []
+    gp = gps[-1]  # the run's final (cumulative) ledger report
+    lines = ["== goodput =="]
+    lines.append(_fmt_row("category", "seconds", "fraction"))
+    total_frac = gp["goodput_frac"]
+    lines.append(_fmt_row(
+        "productive", f"{gp['productive_s']:.2f}",
+        f"{gp['goodput_frac']:.3f}",
+    ))
+    for cat in GOODPUT_CATEGORIES:
+        total_frac += gp[f"{cat}_frac"]
+        lines.append(_fmt_row(
+            cat, f"{gp[f'{cat}_s']:.2f}", f"{gp[f'{cat}_frac']:.3f}"
+        ))
+    lines.append(_fmt_row("wall", f"{gp['wall_s']:.2f}",
+                          f"{total_frac:.3f}"))
+    out["goodput_frac"] = round(gp["goodput_frac"], 4)
+    out["goodput_wall_s"] = round(gp["wall_s"], 2)
+    for cat in GOODPUT_CATEGORIES:
+        out[f"goodput_{cat}_frac"] = round(gp[f"{cat}_frac"], 4)
+    return lines
+
+
+def train_section(records: List[dict], out: dict) -> List[str]:
+    trains = [r for r in records if r.get("kind") == "train"]
+    epochs = [r for r in records if r.get("kind") == "epoch_timing"]
+    if not trains and not epochs:
+        return []
+    lines = ["== training =="]
+    if trains:
+        last = trains[-1]
+        lines.append(
+            f"  {len(trains)} log events; last: epoch {last.get('epoch')} "
+            f"step {last.get('step')} loss {last.get('loss', float('nan')):.4f}"
+        )
+        out["train_log_events"] = len(trains)
+        out["train_last_loss"] = last.get("loss")
+    for r in epochs:
+        rate = r.get("tokens_per_s") or r.get("items_per_s")
+        rate_s = f", {rate:.0f}/s" if rate else ""
+        lines.append(
+            f"  epoch {r['epoch']}: {r['steps']} steps, "
+            f"{r['mean_ms']:.1f} ms/step{rate_s}"
+        )
+    if epochs:
+        out["train_mean_step_ms"] = round(epochs[-1]["mean_ms"], 2)
+    return lines
+
+
+def serving_section(records: List[dict], out: dict) -> List[str]:
+    reqs = [r for r in records if r.get("kind") == "request"]
+    summaries = [r for r in records if r.get("kind") == "serving_summary"]
+    if not reqs and not summaries:
+        return []
+    lines = ["== serving latency =="]
+    if reqs:
+        # exact recomputation from the raw per-request records
+        ttft = [r["ttft_s"] for r in reqs if "ttft_s" in r]
+        queue = [r["queue_wait_s"] for r in reqs if "queue_wait_s" in r]
+        gaps = [g for r in reqs for g in r.get("token_gaps_s", [])]
+        lines.append(
+            f"  {len(reqs)} requests, "
+            f"{sum(r.get('new_tokens', 0) for r in reqs)} tokens"
+        )
+        out["serving_requests"] = len(reqs)
+        for name, vals in (("ttft", ttft), ("token_lat", gaps),
+                           ("queue_wait", queue)):
+            ps = percentiles(vals, qs=(50, 95))
+            if not ps:
+                continue
+            lines.append(_fmt_row(
+                name,
+                f"p50 {ps['p50'] * 1e3:.1f}ms",
+                f"p95 {ps['p95'] * 1e3:.1f}ms",
+            ))
+            out[f"serving_{name}_p50_ms"] = round(ps["p50"] * 1e3, 3)
+            out[f"serving_{name}_p95_ms"] = round(ps["p95"] * 1e3, 3)
+    elif summaries:
+        s = summaries[-1]
+        for name in ("ttft", "token_lat", "queue_wait"):
+            p50, p95 = s.get(f"{name}_p50_s"), s.get(f"{name}_p95_s")
+            if p50 is None:
+                continue
+            lines.append(_fmt_row(
+                name, f"p50 {p50 * 1e3:.1f}ms", f"p95 {p95 * 1e3:.1f}ms"
+            ))
+            out[f"serving_{name}_p50_ms"] = round(p50 * 1e3, 3)
+            out[f"serving_{name}_p95_ms"] = round(p95 * 1e3, 3)
+    if summaries:
+        s = summaries[-1]
+        for k in ("tokens_per_s", "occupancy_mean", "padding_waste_frac"):
+            if k in s:
+                out[f"serving_{k}"] = round(float(s[k]), 4)
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="+", help="telemetry JSONL file(s)")
+    p.add_argument("--json", action="store_true",
+                   help="append one flat JSON dict (bench.py style)")
+    p.add_argument("--require", default=None,
+                   help="comma list of sections that MUST be present "
+                        "(goodput, serving) — exit non-zero otherwise; "
+                        "the ci_check.sh --telemetry-smoke gate")
+    args = p.parse_args(argv)
+
+    records = load_records(args.paths)
+    out: dict = {}
+    lines: List[str] = []
+    lines += goodput_section(records, out)
+    lines += train_section(records, out)
+    lines += serving_section(records, out)
+    if not lines:
+        print(f"no telemetry records in {args.paths}", file=sys.stderr)
+        return 2
+    print("\n".join(lines))
+    has_goodput = "goodput_frac" in out
+    has_latency = "serving_ttft_p50_ms" in out
+    if not (has_goodput or has_latency):
+        print("neither a goodput record nor serving latencies found",
+              file=sys.stderr)
+        return 2
+    required = {s for s in (args.require or "").split(",") if s}
+    unknown = required - {"goodput", "serving"}
+    if unknown:
+        print(f"--require: unknown sections {sorted(unknown)}",
+              file=sys.stderr)
+        return 2
+    if "goodput" in required and not has_goodput:
+        print("--require goodput: no goodput record found", file=sys.stderr)
+        return 2
+    if "serving" in required and not has_latency:
+        print("--require serving: no serving latencies found",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
