@@ -185,18 +185,29 @@ def paged_attention(
         sees, not excise ops into custom calls). ``"pallas"`` is reserved
         for a fused gather-attend kernel and currently raises — the flag
         exists so call sites are already plumbed when the kernel lands.
+        When it does land, it must join the program-registry bucket
+        enumeration (``compilecache.serving_registry`` over
+        ``PagedEngine.chunk_buckets``; ANALYSIS.md "Cold start & compile
+        cache"): a kernel variant that compiles per bucket outside the
+        registry trips the coverage guard, and the warmup runtime must be
+        able to prewarm it like the dense spelling.
 
     Returns ``[B, C, H, D]`` in q's dtype. Softmax statistics in fp32.
     """
     if gather_impl == "pallas":
         raise NotImplementedError(
             "gather_impl='pallas' (fused block-gather attention kernel) is "
-            "reserved but not implemented; use the default 'dense' spelling"
+            "reserved but not implemented; use the default 'dense' "
+            "spelling. When the kernel lands it must register its bucket "
+            "programs with compilecache.serving_registry (ANALYSIS.md "
+            "'Cold start & compile cache') so warmup can prewarm them and "
+            "the coverage guard keeps predicting every compiled variant"
         )
     if gather_impl != "dense":
         raise ValueError(
             f"gather_impl {gather_impl!r} must be 'dense' (or the reserved "
-            "'pallas')"
+            "'pallas'); see compilecache/registry.py for the bucket "
+            "enumeration any new impl must stay in sync with"
         )
     b, c, h, d = q.shape
     n_blocks, block_len, h_kv, _ = k_pool.shape

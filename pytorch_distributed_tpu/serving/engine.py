@@ -70,7 +70,18 @@ class PagedEngine:
     (``serving.scheduler.Scheduler`` or the rewired
     ``models.generate.ContinuousBatcher``) decides what to admit and
     when to decode, and owns per-slot positions/budgets.
+
+    Every program the engine can compile is enumerable AHEAD of traffic
+    (``chunk_buckets`` + the decode tick): ``compilecache.serving_registry``
+    builds the AOT/warmup registry from exactly these methods, so the
+    registry and the lazy ``run_chunks`` bucketing can never drift — the
+    coverage guard (``ProgramRegistry.assert_covers`` over
+    ``compiled_program_names()``) fails if a compiled program ever appears
+    that the enumeration did not predict.
     """
+
+    #: registry name of the shared decode program
+    DECODE_PROGRAM = "decode_tick"
 
     def __init__(self, config, params, n_slots: int, *,
                  n_blocks: Optional[int] = None, block_len: int = 16,
@@ -111,6 +122,13 @@ class PagedEngine:
 
         self._chunk_fns: Dict[Tuple[int, int], callable] = {}
         self._decode_fn = None
+        # buckets whose program has EXECUTED at least once (call path hot:
+        # the next call pays zero compile/load) — run_chunks/decode and the
+        # execute-mode warmups add to these; AOT-only warmup does not (the
+        # first real call still pays a trace + persistent-cache load, so
+        # the scheduler's cold-request accounting stays honest)
+        self._hot_chunks: set = set()
+        self._hot_decode = False
         if tp:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -229,6 +247,132 @@ class PagedEngine:
         self._decode_fn = jax.jit(body, donate_argnums=(1, 2))
         return self._decode_fn
 
+    # ---- program enumeration + warmup (compilecache.serving_registry) ----
+
+    @staticmethod
+    def chunk_program_name(k_pad: int, wp: int) -> str:
+        """Stable registry identity of one chunk-prefill bucket."""
+        return f"chunk_prefill[k={k_pad},w={wp}]"
+
+    def bucket_for(self, jobs: List["ChunkJob"]) -> Tuple[int, int]:
+        """The (padded job count, table-slice width) bucket ``run_chunks``
+        will compile/run for ``jobs`` — THE bucketing definition; the
+        registry enumeration and the scheduler's cold-request accounting
+        both read it from here."""
+        k_pad = _pow2_bucket(len(jobs))
+        max_end = max(j.start + self.chunk for j in jobs)
+        wp = min(_pow2_bucket(-(-max_end // self.block_len)),
+                 self.table_width)
+        return k_pad, wp
+
+    def chunk_buckets(self) -> List[Tuple[int, int]]:
+        """Every (k_pad, wp) bucket this engine can ever ask for: job
+        counts are 1..n_slots (one chunk job per resident slot, pow2-
+        padded) and table-slice widths are the pow2 widths clipped to
+        ``table_width`` — exactly the values ``bucket_for`` can produce,
+        because admission rejects prompts whose padded length exceeds
+        ``max_seq_len`` (so ``max_end`` never needs more than
+        ``table_width`` blocks)."""
+        ks, k = [], 1
+        while k < self.n_slots:
+            ks.append(k)
+            k <<= 1
+        ks.append(_pow2_bucket(self.n_slots))
+        ws, w = [], 1
+        while w < self.table_width:
+            ws.append(w)
+            w <<= 1
+        ws.append(self.table_width)
+        return [(k, w) for k in ks for w in sorted(set(ws))]
+
+    def has_chunk_program(self, k_pad: int, wp: int) -> bool:
+        """True when the bucket's call path is hot (executed before)."""
+        return (k_pad, wp) in self._hot_chunks
+
+    @property
+    def has_decode_program(self) -> bool:
+        return self._hot_decode
+
+    def compiled_program_names(self) -> List[str]:
+        """Live program inventory for the registry coverage guard."""
+        names = [self.chunk_program_name(k, w) for k, w in
+                 sorted(self._chunk_fns)]
+        if self._decode_fn is not None:
+            names.append(self.DECODE_PROGRAM)
+        return names
+
+    def _cache_logits_avals(self):
+        sds = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
+            x.shape, x.dtype, sharding=x.sharding
+        )
+        return jax.tree.map(sds, self.cache), sds(self.logits)
+
+    def warm_chunk(self, k_pad: int, wp: int, execute: bool = True) -> None:
+        """Force the (k_pad, wp) chunk program compiled before traffic
+        needs it.
+
+        ``execute=True`` runs it once with inert inputs — every job is a
+        padding job (slot ``n_slots``: the logits scatter drops it) whose
+        table points at the trash block, so the pool's live blocks and
+        the logits buffer are untouched — leaving the jit call path hot:
+        the first real request into this bucket pays nothing. Only safe
+        when the caller is not concurrently running programs that donate
+        the same cache/logits buffers (i.e. before serving, or from the
+        serving thread itself).
+
+        ``execute=False`` AOT-compiles via ``lower(...).compile()`` — no
+        buffer is touched, so a background thread can do it mid-traffic;
+        it feeds the persistent compilation cache
+        (``compilecache.aot.enable_persistent_cache``), turning the
+        bucket's eventual first call from an XLA compile into a disk
+        load.
+        """
+        fn = self._chunk_fn(k_pad, wp)
+        c = self.chunk
+        tokens = jnp.zeros((k_pad, c), jnp.int32)
+        starts = jnp.zeros((k_pad,), jnp.int32)
+        tables = jnp.full((k_pad, wp), TRASH_BLOCK, jnp.int32)
+        slots = jnp.full((k_pad,), self.n_slots, jnp.int32)
+        is_last = jnp.zeros((k_pad,), bool)
+        last_idx = jnp.zeros((k_pad,), jnp.int32)
+        if execute:
+            self.cache, self.logits = fn(
+                self.params, self.cache, self.logits, tokens, starts,
+                tables, slots, is_last, last_idx,
+            )
+            self._hot_chunks.add((k_pad, wp))
+        else:
+            cache_aval, logits_aval = self._cache_logits_avals()
+            fn.lower(
+                self.params, cache_aval, logits_aval, tokens, starts,
+                tables, slots, is_last, last_idx,
+            ).compile()
+
+    def warm_decode(self, execute: bool = True) -> None:
+        """Force the decode tick compiled — same contract as
+        ``warm_chunk``. The inert execution decodes with every lane
+        inactive: cache writes go to the trash block and the logits
+        buffer's garbage rows are rewritten by each slot's final prefill
+        chunk before any real decode reads them."""
+        fn = self._decode()
+        positions = jnp.zeros((self.n_slots,), jnp.int32)
+        active = jnp.zeros((self.n_slots,), bool)
+        tables = jnp.full((self.n_slots, self.table_width), TRASH_BLOCK,
+                          jnp.int32)
+        rng = jax.random.key(0)
+        if execute:
+            self.cache, self.logits, _, _ = fn(
+                self.params, self.cache, self.logits, positions, active,
+                tables, rng,
+            )
+            self._hot_decode = True
+        else:
+            cache_aval, logits_aval = self._cache_logits_avals()
+            fn.lower(
+                self.params, cache_aval, logits_aval, positions, active,
+                tables, rng,
+            ).compile()
+
     # ---- slot-level operations ----
 
     def blocks_for(self, prompt_len: int, max_new_tokens: int) -> int:
@@ -278,10 +422,7 @@ class PagedEngine:
                     f"chunk job for slot {j.slot} has {len(j.tokens)} "
                     f"tokens; engine chunk length is {c}"
                 )
-        k_pad = _pow2_bucket(len(jobs))
-        max_end = max(j.start + c for j in jobs)
-        wp = min(_pow2_bucket(-(-max_end // self.block_len)),
-                 self.table_width)
+        k_pad, wp = self.bucket_for(jobs)
         tokens = np.zeros((k_pad, c), np.int32)
         starts = np.zeros((k_pad,), np.int32)
         tables = np.full((k_pad, wp), TRASH_BLOCK, np.int32)
@@ -302,6 +443,7 @@ class PagedEngine:
             jnp.asarray(starts), jnp.asarray(tables), jnp.asarray(slots),
             jnp.asarray(is_last), jnp.asarray(last_idx),
         )
+        self._hot_chunks.add((k_pad, wp))
 
     def decode(self, positions: np.ndarray, active: np.ndarray, rng):
         """One decode tick for every slot; samples from the logits
@@ -315,4 +457,5 @@ class PagedEngine:
             jnp.asarray(positions, jnp.int32), jnp.asarray(active),
             jnp.asarray(masked), rng,
         )
+        self._hot_decode = True
         return np.asarray(tokens), np.array(positions)

@@ -45,7 +45,12 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from pytorch_distributed_tpu.telemetry import NULL_TRACER, LatencySeries
+from pytorch_distributed_tpu.compilecache.aot import attribute_compile
+from pytorch_distributed_tpu.telemetry import (
+    NULL_TRACER,
+    GoodputLedger,
+    LatencySeries,
+)
 
 
 @dataclasses.dataclass
@@ -65,6 +70,13 @@ class Request:
     # inter-token gaps AFTER the first token (the decode-tick latency
     # this request's stream observed; the first token's latency is TTFT)
     token_gaps: List[float] = dataclasses.field(default_factory=list)
+    # True when a compile stall landed inside this request's lifetime: a
+    # prefill chunk of its batch hit a not-yet-hot bucket program, or its
+    # first decode tick compiled the decode program. Cold requests' TTFT
+    # pollutes p99 with XLA compile time — the per-request JSONL carries
+    # the flag so percentiles can be reported warm-only vs all (and the
+    # warmup runtime exists to make every request warm).
+    cold: bool = False
 
     @property
     def length(self) -> int:
@@ -123,10 +135,51 @@ class Scheduler:
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.metrics_log = metrics_log
         self.ttft = LatencySeries("ttft")
+        # warm-only TTFT: requests whose lifetime saw no compile stall —
+        # the honest SLO series (cold first-bucket requests excluded)
+        self.ttft_warm = LatencySeries("ttft_warm")
         self.token_lat = LatencySeries("token_lat")
         self.queue_wait = LatencySeries("queue_wait")
+        self._cold_requests = 0
+        # wall-time ledger: serving attributes its compile stalls (lazy
+        # first-bucket compiles AND warmup compile time) so cold-vs-warm
+        # starts compare on one number — goodput compile fraction
+        self.goodput = GoodputLedger()
+        self.goodput.start()
 
     # ---- API ----
+
+    def warmup(self, background: bool = True):
+        """Compile every program this scheduler can ever run, BEFORE
+        traffic (compilecache/: ANALYSIS.md "Cold start & compile cache").
+
+        The decode tick and the smallest prefill bucket compile (and
+        execute inert) in the foreground — serving can start the moment
+        this returns, with the serve-critical path hot; the remaining
+        buckets AOT-compile on a background thread into the persistent
+        compilation cache. ``background=False`` compiles everything in
+        the foreground with inert execution: zero cold requests, the
+        strongest guarantee, at full upfront cost.
+
+        Warmup compile time lands in the ledger's ``compile`` category
+        and each program emits a ``kind="warmup"`` manifest record to
+        ``metrics_log`` — so a cold start (fresh cache) and a warm start
+        (populated cache) compare on the goodput compile fraction.
+        Returns the ``WarmupRunner`` (``.wait()`` joins the background
+        thread; ``.summary()`` aggregates the manifest).
+        """
+        from pytorch_distributed_tpu.compilecache import (
+            WarmupRunner,
+            serving_registry,
+        )
+
+        runner = WarmupRunner(
+            serving_registry(self.engine),
+            tracer=self.tracer,
+            ledger=self.goodput,
+            manifest=self.metrics_log,
+        )
+        return runner.run(background=background)
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
         """Enqueue one request; returns its request id. Never raises for
@@ -213,7 +266,19 @@ class Scheduler:
             self._admit()
         jobs = self._chunk_jobs()
         if jobs:
-            with self.tracer.span("prefill_chunk", jobs=len(jobs)):
+            # cold bucket: this batch's (k_pad, wp) program has never
+            # executed — the call below stalls for its compile (or a
+            # persistent-cache load after an AOT-only warmup). Mark every
+            # request riding the batch and book the stall as compile time.
+            cold_bucket = not self.engine.has_chunk_program(
+                *self.engine.bucket_for(jobs)
+            )
+            if cold_bucket:
+                for j in jobs:
+                    self.resident[j.slot].cold = True
+            with self.tracer.span("prefill_chunk", jobs=len(jobs)), \
+                    attribute_compile(self.goodput if cold_bucket
+                                      else None):
                 self.engine.run_chunks(jobs)
             for j in jobs:
                 req = self.resident[j.slot]
@@ -229,7 +294,14 @@ class Scheduler:
         if not active.any():
             return []
         self._rng, sub = jax.random.split(self._rng)
-        with self.tracer.span("decode_tick", lanes=int(active.sum())):
+        cold_decode = not self.engine.has_decode_program
+        if cold_decode:
+            # every active lane's token this tick arrives through the
+            # decode program's first compile — those requests are cold
+            for slot in np.nonzero(active)[0]:
+                self.resident[int(slot)].cold = True
+        with self.tracer.span("decode_tick", lanes=int(active.sum())), \
+                attribute_compile(self.goodput if cold_decode else None):
             tokens, self.positions = self.engine.decode(
                 self.positions, active, sub
             )
@@ -245,6 +317,8 @@ class Scheduler:
             if req.produced == 0:
                 req.first_token_time = now
                 self.ttft.observe(now - req.submit_time)
+                if not req.cold:
+                    self.ttft_warm.observe(now - req.submit_time)
             else:
                 gap = now - req.last_token_time
                 req.token_gaps.append(gap)
@@ -258,6 +332,8 @@ class Scheduler:
                 del self.resident[slot]
                 self.engine.release(slot)
                 self._completed += 1
+                if req.cold:
+                    self._cold_requests += 1
                 self._log_request(req)
             else:
                 self.remaining[slot] -= 1
@@ -273,6 +349,7 @@ class Scheduler:
             rid=req.rid,
             prompt_len=req.length,
             new_tokens=req.produced,
+            cold=req.cold,
             queue_wait_s=round(req.admit_time - req.submit_time, 6),
             ttft_s=round(req.first_token_time - req.submit_time, 6),
             token_gaps_s=[round(g, 6) for g in req.token_gaps],
@@ -335,8 +412,14 @@ class Scheduler:
                 self._adm_latency_s / self._admitted
                 if self._admitted else 0.0
             ),
+            # cold-start honesty: how many retired requests ate a compile
+            # stall, and the compile seconds the ledger attributed —
+            # warm-only TTFT is the SLO series, plain ttft includes cold
+            "cold_requests": self._cold_requests,
+            "compile_s": self.goodput.seconds("compile"),
             # latency percentiles — the SLO surface (exact, host-side)
             **self.ttft.summary("ttft"),
+            **self.ttft_warm.summary("ttft_warm"),
             **self.token_lat.summary("token_lat"),
             **self.queue_wait.summary("queue_wait"),
         }

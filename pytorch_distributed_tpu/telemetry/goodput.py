@@ -30,8 +30,15 @@ import time
 from typing import Dict, Iterator, Optional
 
 #: The non-productive wall-time classes the trainers attribute.
+#: ``compile`` is XLA backend compilation (including persistent-cache
+#: loads — the part ``compilecache/`` collapses on a warm start);
+#: ``trace`` is the Python tracing/lowering half of a cold first call,
+#: split out by ``compilecache.aot.attribute_compile`` because no disk
+#: cache can remove it — lumping the two would understate a warm start's
+#: win and overstate a cold start's compile time.
 GOODPUT_CATEGORIES = (
     "compile",
+    "trace",
     "data_wait",
     "checkpoint",
     "rollback",

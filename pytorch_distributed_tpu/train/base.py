@@ -82,6 +82,108 @@ class SuspendableTrainer:
                 ledger=self.goodput,
             ).start()
 
+    # ---- compile-cache plumbing (compilecache/: registry, AOT, warmup;
+    # ANALYSIS.md "Cold start & compile cache"). Both trainers call
+    # _init_compilecache FIRST in __init__ (so even flax init and
+    # placement programs land in the persistent cache) and fit() calls
+    # _run_warmup after resume. ----
+
+    def _init_compilecache(self) -> None:
+        """Point jax's persistent compilation cache at the configured
+        directory (config.compile_cache_dir, env PDT_COMPILE_CACHE_DIR
+        fallback) — a relaunched/resumed run with the same fingerprint
+        then loads its executables from disk instead of recompiling."""
+        from pytorch_distributed_tpu.utils.env import (
+            resolve_compile_cache_dir,
+        )
+
+        cache_dir = resolve_compile_cache_dir(
+            getattr(self.config, "compile_cache_dir", None)
+        )
+        if cache_dir:
+            from pytorch_distributed_tpu.compilecache import (
+                enable_persistent_cache,
+            )
+
+            enable_persistent_cache(cache_dir)
+
+    def _registry_entries(self):
+        """Subclass hook: ``[(name, jit_fn, avals_list_thunk,
+        expect_entries)]`` — every compiled step program this trainer
+        runs, with a lazy thunk producing the list of abstract argument
+        tuples (live state + ShapeDtypeStructs carrying the REAL batch
+        shardings) the program compiles for."""
+        return []
+
+    def program_registry(self):
+        """The trainer's AOT program registry: train step + eval step(s),
+        fingerprinted by (env, mesh, trainer config, model config). Warm
+        thunks AOT-compile via ``lower(...).compile()`` — trainer steps
+        must never EXECUTE during warmup (a dummy step would corrupt
+        params/opt state), so the win is the persistent cache: the real
+        first dispatch becomes a disk load."""
+        from pytorch_distributed_tpu.compilecache import (
+            ProgramRegistry,
+            ProgramSpec,
+            jit_cache_size,
+            run_fingerprint,
+        )
+
+        reg = ProgramRegistry(run_fingerprint(
+            mesh=self.mesh,
+            extra=(self.config, getattr(self, "model_config", None)),
+        ))
+        for name, fn, avals_thunk, expect in self._registry_entries():
+            def warm(execute, fn=fn, thunk=avals_thunk):
+                for avals in thunk():
+                    fn.lower(*avals).compile()
+
+            reg.add(ProgramSpec(
+                name=name, warm=warm, priority=0, expect_entries=expect,
+                cache_probe=lambda fn=fn: jit_cache_size(fn),
+            ))
+        return reg
+
+    def compiled_program_names(self) -> list:
+        """One element per live jit-cache entry of each step program —
+        the observed side of the registry coverage guard."""
+        from pytorch_distributed_tpu.compilecache import jit_cache_size
+
+        names = []
+        for name, fn, _thunk, _expect in self._registry_entries():
+            n = jit_cache_size(fn)
+            names.extend([name] * (n or 0))
+        return names
+
+    def assert_registry_covers(self) -> None:
+        """Fail (CoverageError) if a step program compiled more variants
+        than the registry predicted — the trainers' half of the
+        acceptance guard (the serving half audits PagedEngine)."""
+        self.program_registry().assert_covers(self.compiled_program_names())
+
+    def _run_warmup(self) -> None:
+        """``config.warmup``: AOT-compile every registry entry before the
+        first step, attributing the wall time to the goodput ledger's
+        ``compile`` category and appending ``kind="warmup"`` manifest
+        records to the metrics JSONL."""
+        if not getattr(self.config, "warmup", False):
+            return
+        from pytorch_distributed_tpu.compilecache import WarmupRunner
+
+        runner = WarmupRunner(
+            self.program_registry(),
+            tracer=self.tracer,
+            ledger=self.goodput,
+            manifest=getattr(self, "metrics_log", None),
+        )
+        runner.run(background=False)  # AOT thunks are traffic-safe anyway
+        s = runner.summary()
+        rank0_print(
+            f"warmup: {s['programs']} programs in {s['total_s']:.2f}s "
+            f"({s['cache_hits']} cache hits, {s['fresh']} fresh; "
+            f"fingerprint {s['fingerprint']})"
+        )
+
     # ---- telemetry plumbing (telemetry/: device ring, spans, goodput).
     # The trainers push each log event's device metric scalars through
     # _telemetry_append instead of blocking on float(); records drain

@@ -34,6 +34,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pytorch_distributed_tpu.compilecache.aot import attribute_compile
 from pytorch_distributed_tpu.ops.optim import build_optimizer
 from pytorch_distributed_tpu.ops.schedules import warmup_cosine
 from pytorch_distributed_tpu.parallel import mesh as mesh_lib
@@ -146,6 +147,16 @@ class LMTrainerConfig:
     metrics_out: Optional[str] = None
     trace_dir: Optional[str] = None
     flush_every: int = 32
+    # Compile cache (compilecache/, ANALYSIS.md "Cold start & compile
+    # cache"): compile_cache_dir points jax's persistent compilation
+    # cache at a directory (env fallback PDT_COMPILE_CACHE_DIR) so a
+    # relaunched or preemption-resumed run loads its step executables
+    # from disk; warmup AOT-compiles the program registry (train + eval
+    # step) before the first step, with the wall time attributed to the
+    # goodput ledger's compile category and kind="warmup" manifest
+    # records in the metrics JSONL.
+    compile_cache_dir: Optional[str] = None
+    warmup: bool = False
 
 
 class LMTrainer(SuspendableTrainer):
@@ -164,6 +175,7 @@ class LMTrainer(SuspendableTrainer):
 
         self.config = config
         self.model_config = model_config
+        self._init_compilecache()  # before any compile: init programs too
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.watcher = suspend_watcher or NullSuspendWatcher()
         self.ckpt = Checkpointer(config.save_dir)
@@ -336,6 +348,50 @@ class LMTrainer(SuspendableTrainer):
             or os.path.join(config.save_dir, "metrics.jsonl")
         )
 
+    # ---- program registry (compilecache/): the programs this trainer
+    # compiles, with the batch avals the loader will actually produce ----
+
+    def _registry_entries(self):
+        sample = self.train_loader.collate_fn([self.train_loader.dataset[0]])
+        gb = self._local_batch * jax.process_count()
+        if mesh_lib.SEQ_AXIS in self.mesh.shape:
+            spec = P(mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS)
+        else:  # PP (data, stage, model) meshes shard over data only
+            spec = P(mesh_lib.DATA_AXIS)
+        sharding = NamedSharding(self.mesh, spec)
+
+        def batch_aval():
+            return {
+                k: jax.ShapeDtypeStruct(
+                    (gb,) + np.asarray(v).shape[1:], np.asarray(v).dtype,
+                    sharding=sharding,
+                )
+                for k, v in sample.items()
+            }
+
+        def train_avals():
+            return [(self.state, batch_aval())]
+
+        def eval_avals():
+            # validate() zero-pads partial batches back to the full local
+            # batch, so the eval step holds exactly ONE shape
+            acc = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=mesh_lib.replicated_sharding(self.mesh),
+                ),
+                empty_lm_metrics(),
+            )
+            return [(self.state, batch_aval(), acc)]
+
+        # train budget 2: steady-state entry + the donation/layout retrace
+        # the first dispatch settles through — the same pair no_recompile's
+        # warmup_steps=2 window forgives (analysis/guards.py)
+        return [
+            ("lm_train_step", self.train_step, train_avals, 2),
+            ("lm_eval_step", self.eval_step, eval_avals, 1),
+        ]
+
     # ---- checkpoint contract: shared machinery in train/base.py ----
 
     def _extra_payload(self) -> dict:
@@ -387,12 +443,14 @@ class LMTrainer(SuspendableTrainer):
                 self.mesh, host_batch,
                 layout=self.model_config.ring_layout,
             )
-            td = time.perf_counter()
-            with self.tracer.span("step_dispatch", step=step):
+            # the run's first dispatch traces + compiles the step: split
+            # its wall into compile (XLA backend / cache load) and trace
+            # (Python lowering) so a warm start's ledger shows the cache
+            # win; later recompiles are a guarded hazard, not steady state
+            first = self._dispatched == 0
+            with self.tracer.span("step_dispatch", step=step), \
+                    attribute_compile(self.goodput if first else None):
                 self.state, metrics = self.train_step(self.state, batch)
-            if self._dispatched == 0:
-                # the run's first dispatch traces + compiles the step
-                self.goodput.add("compile", time.perf_counter() - td)
             self._dispatched += 1
             self._post_step(metrics)
             steps_done += 1
@@ -485,6 +543,7 @@ class LMTrainer(SuspendableTrainer):
 
         self.goodput.start()
         self.try_resume()
+        self._run_warmup()  # AOT-compile the registry before step 1
         summary: dict = {}
         epoch = self.start_epoch
         while epoch < self.config.epochs:

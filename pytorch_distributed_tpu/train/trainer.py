@@ -31,6 +31,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from pytorch_distributed_tpu.compilecache.aot import attribute_compile
 from pytorch_distributed_tpu.ops.metrics import ClassificationMetrics
 from pytorch_distributed_tpu.ops.optim import sgd_with_weight_decay
 from pytorch_distributed_tpu.ops.precision import DynamicLossScaler, NoOpLossScaler
@@ -106,6 +107,13 @@ class TrainerConfig:
     metrics_out: Optional[str] = None
     trace_dir: Optional[str] = None
     flush_every: int = 32
+    # Compile cache (compilecache/, ANALYSIS.md "Cold start & compile
+    # cache"): compile_cache_dir points jax's persistent compilation
+    # cache at a directory (env fallback PDT_COMPILE_CACHE_DIR);
+    # warmup AOT-compiles the train/eval program registry before the
+    # first step (ledger compile attribution + kind="warmup" manifest).
+    compile_cache_dir: Optional[str] = None
+    warmup: bool = False
 
 
 class Trainer(SuspendableTrainer):
@@ -125,6 +133,7 @@ class Trainer(SuspendableTrainer):
 
         self.config = config
         self.model = model
+        self._init_compilecache()  # before any compile: init programs too
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.watcher = suspend_watcher or NullSuspendWatcher()
         self.ckpt = Checkpointer(config.save_dir)
@@ -223,6 +232,68 @@ class Trainer(SuspendableTrainer):
             or os.path.join(config.save_dir, "metrics.jsonl")
         )
 
+    # ---- program registry (compilecache/): the programs this trainer
+    # compiles, with the batch avals the loaders will actually produce ----
+
+    def _registry_entries(self):
+        from jax.sharding import PartitionSpec as P  # noqa: F401
+
+        sample = self.train_loader.collate_fn([self.train_loader.dataset[0]])
+        pc = jax.process_count()
+        local_batch = self.train_loader.batch_size
+        gb = local_batch * pc
+        sharding = mesh_lib.batch_sharding(self.mesh)
+
+        def aval_for(b):
+            return {
+                k: jax.ShapeDtypeStruct(
+                    (b,) + np.asarray(v).shape[1:], np.asarray(v).dtype,
+                    sharding=sharding,
+                )
+                for k, v in sample.items()
+            }
+
+        def train_avals():
+            return [(self.state, aval_for(gb))]
+
+        def eval_batch_sizes():
+            # validate() pads a partial FINAL batch only up to replica
+            # divisibility (duplicate-counting val semantics), so the
+            # eval step holds one program per distinct global batch size:
+            # the full batch, plus the padded remainder when the local
+            # sample count doesn't divide evenly.
+            n_local_samples = self.val_sampler.num_samples
+            n_replicas = mesh_lib.local_replica_count(self.mesh)
+            sizes = []
+            if n_local_samples >= local_batch:
+                sizes.append(gb)
+            rem = n_local_samples % local_batch
+            if rem:
+                rem += (-rem) % n_replicas
+                if rem * pc not in sizes:
+                    sizes.append(rem * pc)
+            return sizes
+
+        def eval_avals():
+            metrics = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=mesh_lib.replicated_sharding(self.mesh),
+                ),
+                ClassificationMetrics.empty(),
+            )
+            return [(self.state, aval_for(b), metrics)
+                    for b in eval_batch_sizes()]
+
+        # train budget 2: steady-state entry + the donation/layout retrace
+        # the first dispatch settles through — the same pair no_recompile's
+        # warmup_steps=2 window forgives (analysis/guards.py)
+        return [
+            ("train_step", self.train_step, train_avals, 2),
+            ("eval_step", self.eval_step, eval_avals,
+             max(len(eval_batch_sizes()), 1)),
+        ]
+
     # ---- checkpoint contract (SURVEY.md §3.5): shared machinery in
     # train/base.py (payload gather, resume placement, suspend agreement);
     # the payload reads the trainer's LIVE best_acc, fixing the reference's
@@ -278,13 +349,14 @@ class Trainer(SuspendableTrainer):
             step, host_batch = pair
             host_batch = self._pre_step(host_batch)
             batch = mesh_lib.shard_batch(self.mesh, host_batch)
-            td = time.perf_counter()
-            with self.tracer.span("step_dispatch", step=step):
+            # the run's first dispatch traces + compiles the step: split
+            # its wall into compile (XLA backend / cache load) and trace
+            # (Python lowering) so a warm start's ledger shows the cache
+            # win; later recompiles are a guarded hazard, not steady state
+            first = self._dispatched == 0
+            with self.tracer.span("step_dispatch", step=step), \
+                    attribute_compile(self.goodput if first else None):
                 self.state, metrics = self.train_step(self.state, batch)
-            if self._dispatched == 0:
-                # the run's first dispatch traces + compiles the step;
-                # later recompiles are a guarded hazard, not steady state
-                self.goodput.add("compile", time.perf_counter() - td)
             self._dispatched += 1
             self._post_step(metrics)
             steps_done += 1
@@ -363,6 +435,7 @@ class Trainer(SuspendableTrainer):
 
         self.goodput.start()
         self.try_resume()
+        self._run_warmup()  # AOT-compile the registry before step 1
         summary: dict = {}
         first_epoch = self.start_epoch  # trace only the first epoch run
         epoch = self.start_epoch

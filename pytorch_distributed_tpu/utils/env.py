@@ -102,3 +102,19 @@ def set_env(name: str = "202607", strict: bool = False) -> EnvManifest:
 
 def active_env() -> str | None:
     return _active_env
+
+
+def resolve_compile_cache_dir(cli_value: str | None = None) -> str | None:
+    """The compile-cache directory a run should use: an explicit value
+    (``--compile-cache-dir`` / ``TrainerConfig.compile_cache_dir``) wins,
+    else the ``PDT_COMPILE_CACHE_DIR`` environment fallback, else None
+    (persistent caching off — unless ``set_env`` already established the
+    process-wide ``JAX_COMPILATION_CACHE_DIR`` default).
+
+    This is the one resolution rule every entry point shares (recipes,
+    trainers, ``scripts/warmup.py``, ``scripts/bench_coldstart.py``), so
+    a cluster can point every job at a shared cache with one env var.
+    """
+    if cli_value:
+        return cli_value
+    return os.environ.get("PDT_COMPILE_CACHE_DIR") or None

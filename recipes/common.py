@@ -100,6 +100,24 @@ def _base_parser(description: str, save_dir: str,
                         "lagged transfer per window — logging never "
                         "blocks the dispatch pipeline (0 = legacy "
                         "blocking float() sync per log interval)")
+    # Compile cache (compilecache/; ANALYSIS.md "Cold start & compile
+    # cache"). Example — a preemption-resumed run that reloads its step
+    # executables from disk instead of recompiling:
+    #   python recipes/lm_pretrain.py --tiny --warmup \
+    #       --compile-cache-dir /shared/pdt_cache
+    # (or point every job at one cache: export PDT_COMPILE_CACHE_DIR=...)
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent XLA compilation cache directory (env "
+                        "fallback PDT_COMPILE_CACHE_DIR): a relaunched or "
+                        "preemption-resumed run with the same fingerprint "
+                        "loads executables from disk instead of "
+                        "recompiling")
+    p.add_argument("--warmup", action="store_true",
+                   help="AOT-compile the run's program registry (train + "
+                        "eval step) before step 1 — with a populated "
+                        "--compile-cache-dir the goodput compile fraction "
+                        "collapses; kind=\"warmup\" manifest records land "
+                        "in the metrics JSONL")
     return p
 
 
@@ -190,6 +208,8 @@ def run(args, mesh, precision: str = "fp32") -> dict:
         metrics_out=args.metrics_out,
         trace_dir=args.trace_dir,
         flush_every=args.flush_every,
+        compile_cache_dir=args.compile_cache_dir,
+        warmup=args.warmup,
     )
     trainer = Trainer(
         model,

@@ -183,6 +183,8 @@ def main() -> None:
         metrics_out=args.metrics_out,
         trace_dir=args.trace_dir,
         flush_every=args.flush_every,
+        compile_cache_dir=args.compile_cache_dir,
+        warmup=args.warmup,
     )
     trainer = LMTrainer(model_cfg, train_ds, val_ds, cfg, mesh=mesh,
                         suspend_watcher=SuspendWatcher())

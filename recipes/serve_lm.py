@@ -22,6 +22,14 @@ retirement (queue wait, TTFT, inter-token gaps) plus a final
 feed it to ``scripts/telemetry_report.py`` for TTFT/per-token p50/p95;
 ``--trace-dir DIR`` writes the host span Chrome trace
 (admission/prefill_chunk/decode_tick) to ``DIR/spans.trace.json``.
+
+Cold start (round 8; ANALYSIS.md "Cold start & compile cache"):
+``--warmup`` compiles every registry program (decode tick + all prefill
+buckets) before admitting traffic, and ``--compile-cache-dir`` points
+jax's persistent compilation cache at a directory so a relaunched server
+loads those programs from disk — ``scripts/warmup.py`` prewarms the
+cache out-of-band and ``scripts/bench_coldstart.py`` proves the
+compile-fraction collapse.
 """
 
 from common import parse_args  # noqa: F401  (bootstraps sys.path)
@@ -77,6 +85,19 @@ def _parse() -> argparse.Namespace:
                    help="write the host span Chrome trace "
                         "(admission/prefill_chunk/decode_tick) to "
                         "<dir>/spans.trace.json")
+    # Compile cache (compilecache/; ANALYSIS.md "Cold start & compile
+    # cache"). Example — prewarm once, then every server start is warm:
+    #   python scripts/warmup.py --tiny --compile-cache-dir /tmp/cc
+    #   python recipes/serve_lm.py --tiny --warmup --compile-cache-dir /tmp/cc
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent XLA compilation cache directory (env "
+                        "fallback PDT_COMPILE_CACHE_DIR): a relaunched "
+                        "server loads its bucket programs from disk "
+                        "instead of recompiling mid-traffic")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile every registry program (decode tick + "
+                        "all prefill buckets) before admitting traffic — "
+                        "zero cold requests; paged layout only")
     return p.parse_args()
 
 
@@ -104,6 +125,16 @@ def _prompts(args, cfg):
 
 def main() -> None:
     args = _parse()
+    from pytorch_distributed_tpu.utils.env import resolve_compile_cache_dir
+
+    cache_dir = resolve_compile_cache_dir(args.compile_cache_dir)
+    if cache_dir:
+        from pytorch_distributed_tpu.compilecache import (
+            enable_persistent_cache,
+        )
+
+        # before the model init below: its programs land in the cache too
+        enable_persistent_cache(cache_dir)
     cfg, params = _model(args)
     prompts = _prompts(args, cfg)
     from pytorch_distributed_tpu.telemetry import NULL_TRACER, SpanTracer
@@ -113,6 +144,10 @@ def main() -> None:
     mlog = MetricsLogger(args.metrics_out)
     t0 = time.perf_counter()
     if args.dense:
+        if args.warmup:
+            raise SystemExit("--warmup needs the paged layout (the dense "
+                             "ContinuousBatcher has no program registry); "
+                             "drop --dense")
         # r4 layout: no queue — submit when a slot frees, the admission
         # itself copying the slot's full max_seq_len KV row
         b = ContinuousBatcher(
@@ -133,6 +168,15 @@ def main() -> None:
             admit_per_step=args.admit_per_step, seed=args.seed,
             tracer=tracer, metrics_log=mlog,
         )
+        if args.warmup:
+            # everything foreground + executed inert: the serve loop below
+            # admits immediately after, so every request must be warm
+            runner = s.warmup(background=False)
+            ws = runner.summary()
+            rank0_print(
+                f"warmup: {ws['programs']} programs in "
+                f"{ws['total_s']:.2f}s ({ws['cache_hits']} cache hits)"
+            )
         for p in prompts:
             s.submit(p, args.max_new)
         streams = s.drain()

@@ -1,0 +1,272 @@
+"""Cold-vs-warm start benchmark: the compilecache/ subsystem's proof.
+
+Runs the same workload in TWO child processes sharing one persistent
+compile cache directory:
+
+- **cold**: fresh (empty) cache — warmup compiles every registry program
+  from scratch; the goodput ledger's ``compile`` fraction is the cold-
+  start tax;
+- **warm**: second process start against the now-populated cache — every
+  program loads from disk, the warmup manifest reports cache hits, and
+  the compile fraction must collapse (``--min-ratio``, default 5x, is
+  asserted: exit non-zero otherwise — this is the acceptance gate
+  ``scripts/ci_check.sh --warmup-smoke`` runs).
+
+``--include-lazy`` adds a third child with NO warmup and NO cache: the
+pre-compilecache behavior, where the first request into every prefill
+bucket eats its compile mid-traffic — its ``cold_requests`` count and
+all-vs-warm-only TTFT gap demonstrate the honesty fix (per-request
+``cold`` flag) this subsystem's satellite added.
+
+    python scripts/bench_coldstart.py                     # serve, tiny
+    python scripts/bench_coldstart.py --mode train
+    python scripts/bench_coldstart.py --include-lazy --json out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _parse() -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mode", default="serve", choices=["serve", "train"],
+                   help="workload: paged-serving cycle or LM trainer fit")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="cache dir shared by the children (default: a "
+                        "fresh temp dir, removed afterwards)")
+    p.add_argument("--requests", type=int, default=48,
+                   help="serve mode: synthetic requests")
+    p.add_argument("--max-new", type=int, default=32,
+                   help="serve mode: decode budget per request")
+    p.add_argument("--slots", type=int, default=4, help="decode lanes")
+    p.add_argument("--steps", type=int, default=300,
+                   help="train mode: approximate train steps")
+    p.add_argument("--min-ratio", type=float, default=5.0,
+                   help="assert cold/warm compile-fraction ratio >= this "
+                        "(0 disables the assertion)")
+    p.add_argument("--include-lazy", action="store_true",
+                   help="also run a no-warmup/no-cache child (the lazy "
+                        "mid-traffic-compile baseline)")
+    p.add_argument("--json", default=None,
+                   help="write the flat bench dict to this path too")
+    p.add_argument("--child", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--metrics-out", default=None, help=argparse.SUPPRESS)
+    return p.parse_args()
+
+
+# ---------------------------------------------------------------------------
+# child workloads (run in subprocesses so each start is a real cold/warm
+# process boundary — in-process jit caches cannot leak between runs)
+# ---------------------------------------------------------------------------
+
+
+def _child_serve(args, t_start: float) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_tpu.models.transformer import (
+        TransformerLM,
+        tiny_config,
+    )
+    from pytorch_distributed_tpu.serving import Scheduler
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    cfg = tiny_config(attention="dense", max_seq_len=128)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size,
+                     size=int(l)).astype(np.int32)
+        for l in rng.integers(4, cfg.max_seq_len - args.max_new,
+                              size=args.requests)
+    ]
+    with MetricsLogger(args.metrics_out) as mlog:
+        s = Scheduler(cfg, params, n_slots=args.slots, block_len=16,
+                      prefill_chunk=32, metrics_log=mlog)
+        if args.child in ("cold", "warm"):
+            s.warmup(background=False)
+        for prompt in prompts:
+            s.submit(prompt, args.max_new)
+        first_token_from_start = None
+        while s.queue or s.resident:
+            if s.step() and first_token_from_start is None:
+                first_token_from_start = time.perf_counter() - t_start
+        m = s.metrics()
+        mlog.log(kind="goodput", **s.goodput.report())
+        mlog.log(kind="serving_summary", layout="paged", **m)
+    gp = s.goodput.report()
+    return {
+        "compile_s": gp["compile_s"],
+        "trace_s": gp["trace_s"],
+        "compile_frac": gp["compile_frac"],
+        "wall_s": gp["wall_s"],
+        "cold_requests": m["cold_requests"],
+        "ttft_p50_s": m.get("ttft_p50_s"),
+        "ttft_warm_p50_s": m.get("ttft_warm_p50_s"),
+        "first_token_from_start_s": first_token_from_start,
+    }
+
+
+def _child_train(args, t_start: float) -> dict:
+    import jax
+
+    from pytorch_distributed_tpu.data import SyntheticTokens
+    from pytorch_distributed_tpu.models.transformer import tiny_config
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.train import LMTrainer, LMTrainerConfig
+
+    mesh = make_mesh(jax.devices()[:4], data_parallel=2, seq_parallel=2)
+    cfg = tiny_config(attention="ring")
+    out_dir = os.path.join(os.path.dirname(args.metrics_out),
+                           f"trainer_{args.child}")
+    tc = LMTrainerConfig(
+        epochs=1, batch_size=2, save_dir=out_dir, log_every=8,
+        warmup=args.child in ("cold", "warm"),
+        compile_cache_dir=(args.compile_cache_dir
+                           if args.child in ("cold", "warm") else None),
+        metrics_out=args.metrics_out,
+    )
+    # batch_size 2 x 2 data replicas = 4 seqs/step
+    train = SyntheticTokens(args.steps * 4, 32, 128)
+    trainer = LMTrainer(cfg, train, SyntheticTokens(8, 32, 128, seed=1),
+                        tc, mesh=mesh)
+    trainer.fit()
+    trainer.assert_registry_covers()
+    gp = trainer.goodput.report()
+    return {
+        "compile_s": gp["compile_s"],
+        "trace_s": gp["trace_s"],
+        "compile_frac": gp["compile_frac"],
+        "wall_s": gp["wall_s"],
+        "fit_from_start_s": time.perf_counter() - t_start,
+    }
+
+
+def _run_child(mode: str, child: str, cache_dir: str, work: str,
+               args) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if mode == "train":
+        flags = env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--mode", mode, "--child", child,
+        "--compile-cache-dir", cache_dir,
+        "--metrics-out", os.path.join(work, f"{child}.jsonl"),
+        "--requests", str(args.requests), "--max-new", str(args.max_new),
+        "--slots", str(args.slots), "--steps", str(args.steps),
+    ]
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=1800)
+    if out.returncode != 0:
+        raise SystemExit(
+            f"{child} child failed (rc={out.returncode}):\n{out.stderr}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    args = _parse()
+
+    if args.child is not None:
+        t_start = time.perf_counter()
+        from pytorch_distributed_tpu.utils.env import set_env
+
+        set_env("202607")
+        if args.child in ("cold", "warm"):
+            from pytorch_distributed_tpu.compilecache import (
+                enable_persistent_cache,
+            )
+
+            enable_persistent_cache(args.compile_cache_dir)
+        result = (_child_serve if args.mode == "serve"
+                  else _child_train)(args, t_start)
+        print(json.dumps(result))
+        return 0
+
+    own_tmp = args.compile_cache_dir is None
+    cache_dir = args.compile_cache_dir or tempfile.mkdtemp(
+        prefix="pdt_coldstart_"
+    )
+    work = tempfile.mkdtemp(prefix="pdt_coldstart_work_")
+    try:
+        results = {}
+        if args.include_lazy:
+            results["lazy"] = _run_child(args.mode, "lazy", cache_dir,
+                                         work, args)
+        results["cold"] = _run_child(args.mode, "cold", cache_dir, work,
+                                     args)
+        results["warm"] = _run_child(args.mode, "warm", cache_dir, work,
+                                     args)
+
+        # warm-start gate: the warmup manifest of the WARM child must
+        # report persistent-cache hits (else the cache never persisted)
+        warm_records = [
+            json.loads(line)
+            for line in open(os.path.join(work, "warm.jsonl"))
+        ]
+        warm_hits = sum(1 for r in warm_records
+                        if r.get("kind") == "warmup" and r.get("cache_hit"))
+
+        cold_frac = results["cold"]["compile_frac"]
+        warm_frac = results["warm"]["compile_frac"]
+        ratio = cold_frac / max(warm_frac, 1e-9)
+        out = {"bench": f"coldstart_{args.mode}",
+               "compile_frac_ratio": round(ratio, 2),
+               "warm_warmup_cache_hits": warm_hits}
+        for tag, r in results.items():
+            for k, v in r.items():
+                out[f"{tag}_{k}"] = (round(v, 4)
+                                     if isinstance(v, float) else v)
+        print(json.dumps(out, indent=2))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=2)
+
+        failures = []
+        if warm_hits < 1:
+            failures.append("warm run's warmup manifest reports zero "
+                            "persistent-cache hits")
+        if args.min_ratio > 0 and ratio < args.min_ratio:
+            failures.append(
+                f"compile fraction only improved {ratio:.1f}x "
+                f"(cold {cold_frac:.3f} -> warm {warm_frac:.3f}); "
+                f"required {args.min_ratio:.1f}x"
+            )
+        if args.mode == "serve" and results["warm"]["cold_requests"]:
+            failures.append(
+                f"warm serve run still had "
+                f"{results['warm']['cold_requests']} cold requests"
+            )
+        if failures:
+            print("FAIL: " + "; ".join(failures), file=sys.stderr)
+            return 1
+        print(f"OK: compile fraction {cold_frac:.3f} -> {warm_frac:.3f} "
+              f"({ratio:.1f}x), {warm_hits} warm cache hits")
+        return 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+        if own_tmp:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,7 @@
 # hazards fail the build while the reviewed pre-existing ones don't.
 #
 # Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke|--serving-smoke|
-#                             --telemetry-smoke]
+#                             --telemetry-smoke|--warmup-smoke]
 #
 # --resilience-smoke: lint, then ONE crash-recovery cycle from the
 # kill-matrix (SIGKILL mid-shard-write → relaunch → assert resume) —
@@ -23,6 +23,15 @@
 # parse BOTH JSONLs and print a goodput breakdown + TTFT/per-token
 # p50/p95 (it exits non-zero otherwise) — the end-to-end proof the
 # observability pipeline (device ring → JSONL → report) still closes.
+#
+# --warmup-smoke: lint, then the compile-cache round trip: prewarm a tiny
+# LM serving registry into a fresh cache (scripts/warmup.py), re-run the
+# prewarmer with --expect-hits (every program must now load from the
+# persistent cache), then a cold-vs-warm serve cycle via
+# scripts/bench_coldstart.py asserting the warm run's goodput compile
+# fraction is below the cold run's (the full >=5x gate is
+# bench_coldstart's default; the smoke uses --min-ratio 1.0 so a
+# contended CI core can't flake it).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -46,6 +55,22 @@ if [[ "${1:-}" == "--serving-smoke" ]]; then
     JAX_PLATFORMS=cpu python -m pytest \
         tests/test_paged_serving.py::test_serving_smoke -q \
         -p no:cacheprovider -p no:xdist -p no:randomly
+    exit 0
+fi
+
+if [[ "${1:-}" == "--warmup-smoke" ]]; then
+    echo "== warmup smoke (prewarm → cache-hit gate → cold-vs-warm serve) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python scripts/warmup.py --tiny \
+        --compile-cache-dir "$smoke/cc" --slots 4 --json
+    JAX_PLATFORMS=cpu python scripts/warmup.py --tiny \
+        --compile-cache-dir "$smoke/cc" --slots 4 --expect-hits --json
+    JAX_PLATFORMS=cpu python scripts/bench_coldstart.py --mode serve \
+        --requests 24 --max-new 16 --min-ratio 1.0 \
+        --json "$smoke/coldstart.json"
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/cc/warmup_manifest.jsonl" --json --require warmup
     exit 0
 fi
 

@@ -78,16 +78,19 @@ def goodput_section(records: List[dict], out: dict) -> List[str]:
         f"{gp['goodput_frac']:.3f}",
     ))
     for cat in GOODPUT_CATEGORIES:
-        total_frac += gp[f"{cat}_frac"]
+        # .get: records written before a category existed (e.g. "trace",
+        # added with compilecache/) render as zero rather than erroring
+        total_frac += gp.get(f"{cat}_frac", 0.0)
         lines.append(_fmt_row(
-            cat, f"{gp[f'{cat}_s']:.2f}", f"{gp[f'{cat}_frac']:.3f}"
+            cat, f"{gp.get(f'{cat}_s', 0.0):.2f}",
+            f"{gp.get(f'{cat}_frac', 0.0):.3f}"
         ))
     lines.append(_fmt_row("wall", f"{gp['wall_s']:.2f}",
                           f"{total_frac:.3f}"))
     out["goodput_frac"] = round(gp["goodput_frac"], 4)
     out["goodput_wall_s"] = round(gp["wall_s"], 2)
     for cat in GOODPUT_CATEGORIES:
-        out[f"goodput_{cat}_frac"] = round(gp[f"{cat}_frac"], 4)
+        out[f"goodput_{cat}_frac"] = round(gp.get(f"{cat}_frac", 0.0), 4)
     return lines
 
 
@@ -117,6 +120,36 @@ def train_section(records: List[dict], out: dict) -> List[str]:
     return lines
 
 
+def warmup_section(records: List[dict], out: dict) -> List[str]:
+    """Warmup manifest (``kind="warmup"`` from compilecache.WarmupRunner):
+    how many programs compiled ahead of traffic, how many were
+    persistent-cache hits, and the XLA-backend share of the time — the
+    cold-vs-warm start comparison surface."""
+    warms = [r for r in records if r.get("kind") == "warmup"]
+    if not warms:
+        return []
+    hits = sum(1 for r in warms if r.get("cache_hit"))
+    total = sum(r.get("seconds", 0.0) for r in warms)
+    backend = sum(r.get("backend_compile_s", 0.0) for r in warms)
+    lines = ["== warmup =="]
+    lines.append(
+        f"  {len(warms)} programs in {total:.2f}s "
+        f"({hits} cache hits, {len(warms) - hits} fresh; "
+        f"backend compile {backend:.2f}s)"
+    )
+    slowest = max(warms, key=lambda r: r.get("seconds", 0.0))
+    lines.append(
+        f"  slowest: {slowest.get('program')} "
+        f"{slowest.get('seconds', 0.0):.2f}s"
+        f"{' (hit)' if slowest.get('cache_hit') else ''}"
+    )
+    out["warmup_programs"] = len(warms)
+    out["warmup_cache_hits"] = hits
+    out["warmup_total_s"] = round(total, 3)
+    out["warmup_backend_compile_s"] = round(backend, 3)
+    return lines
+
+
 def serving_section(records: List[dict], out: dict) -> List[str]:
     reqs = [r for r in records if r.get("kind") == "request"]
     summaries = [r for r in records if r.get("kind") == "serving_summary"]
@@ -126,15 +159,22 @@ def serving_section(records: List[dict], out: dict) -> List[str]:
     if reqs:
         # exact recomputation from the raw per-request records
         ttft = [r["ttft_s"] for r in reqs if "ttft_s" in r]
+        # warm-only TTFT: requests whose lifetime saw no compile stall
+        # (cold=False; records predating the flag count as warm) — the
+        # honest SLO series a cold first-bucket request would pollute
+        ttft_warm = [r["ttft_s"] for r in reqs
+                     if "ttft_s" in r and not r.get("cold")]
+        cold = sum(1 for r in reqs if r.get("cold"))
         queue = [r["queue_wait_s"] for r in reqs if "queue_wait_s" in r]
         gaps = [g for r in reqs for g in r.get("token_gaps_s", [])]
         lines.append(
-            f"  {len(reqs)} requests, "
+            f"  {len(reqs)} requests ({cold} cold), "
             f"{sum(r.get('new_tokens', 0) for r in reqs)} tokens"
         )
         out["serving_requests"] = len(reqs)
-        for name, vals in (("ttft", ttft), ("token_lat", gaps),
-                           ("queue_wait", queue)):
+        out["serving_cold_requests"] = cold
+        for name, vals in (("ttft", ttft), ("ttft_warm", ttft_warm),
+                           ("token_lat", gaps), ("queue_wait", queue)):
             ps = percentiles(vals, qs=(50, 95))
             if not ps:
                 continue
@@ -171,14 +211,16 @@ def main(argv=None) -> int:
                    help="append one flat JSON dict (bench.py style)")
     p.add_argument("--require", default=None,
                    help="comma list of sections that MUST be present "
-                        "(goodput, serving) — exit non-zero otherwise; "
-                        "the ci_check.sh --telemetry-smoke gate")
+                        "(goodput, serving, warmup) — exit non-zero "
+                        "otherwise; the ci_check.sh --telemetry-smoke "
+                        "and --warmup-smoke gates")
     args = p.parse_args(argv)
 
     records = load_records(args.paths)
     out: dict = {}
     lines: List[str] = []
     lines += goodput_section(records, out)
+    lines += warmup_section(records, out)
     lines += train_section(records, out)
     lines += serving_section(records, out)
     if not lines:
@@ -187,12 +229,13 @@ def main(argv=None) -> int:
     print("\n".join(lines))
     has_goodput = "goodput_frac" in out
     has_latency = "serving_ttft_p50_ms" in out
-    if not (has_goodput or has_latency):
-        print("neither a goodput record nor serving latencies found",
-              file=sys.stderr)
+    has_warmup = "warmup_programs" in out
+    if not (has_goodput or has_latency or has_warmup):
+        print("no goodput record, serving latencies, or warmup manifest "
+              "found", file=sys.stderr)
         return 2
     required = {s for s in (args.require or "").split(",") if s}
-    unknown = required - {"goodput", "serving"}
+    unknown = required - {"goodput", "serving", "warmup"}
     if unknown:
         print(f"--require: unknown sections {sorted(unknown)}",
               file=sys.stderr)
@@ -202,6 +245,10 @@ def main(argv=None) -> int:
         return 2
     if "serving" in required and not has_latency:
         print("--require serving: no serving latencies found",
+              file=sys.stderr)
+        return 2
+    if "warmup" in required and not has_warmup:
+        print("--require warmup: no warmup manifest records found",
               file=sys.stderr)
         return 2
     if args.json:
