@@ -1,0 +1,69 @@
+"""Elastic topology: restore ANY checkpoint onto ANY mesh shape.
+
+The reference repo's premise is preemption — suspend, lose the slice,
+resume on whatever the scheduler hands back. That only works if a
+checkpoint written on mesh (4,2) restores onto (2,2) or (8,1) with
+optimizer state, RNG, data cursor and global step intact. This package
+makes restore mesh-shape-agnostic (ROADMAP item 4):
+
+- ``resolver`` — target shardings derived from the partition-rule tables
+  the trainers own (live-state and manifest-path modes), validated by
+  ``analysis/partition_coverage.py``;
+- ``reader`` — ``load_elastic``: sharded dirs, legacy single files, and
+  torn-checkpoint fallbacks, placed slice-wise per addressable shard
+  from the manifest's block table (no full-global materialization);
+- ``repartition`` — offline relayout for a target topology
+  (``scripts/reshard.py``), no devices needed;
+- ``serving`` — trainer checkpoints loaded at any serving TP degree,
+  reading only the params blocks.
+
+Proof: the cross-topology kill matrix in ``tests/test_reshard.py``
+(SIGKILL on one mesh, resume on others, loss series vs an unpreempted
+control) and ANALYSIS.md "Elastic topology & reshard".
+"""
+
+from pytorch_distributed_tpu.reshard.reader import (
+    ReshardRefused,
+    RestoreInfo,
+    checkpoint_mesh,
+    load_elastic,
+    mesh_desc,
+    mesh_shape_of,
+)
+from pytorch_distributed_tpu.reshard.repartition import (
+    block_layout,
+    repartition,
+)
+from pytorch_distributed_tpu.reshard.resolver import (
+    assert_rules_cover,
+    lm_rules,
+    manifest_specs,
+    payload_shardings,
+    resolve_lm_state_specs,
+    spec_for_path,
+)
+from pytorch_distributed_tpu.reshard.serving import (
+    load_trainer_params,
+    params_template,
+    serving_param_shardings,
+)
+
+__all__ = [
+    "ReshardRefused",
+    "RestoreInfo",
+    "assert_rules_cover",
+    "block_layout",
+    "checkpoint_mesh",
+    "lm_rules",
+    "load_elastic",
+    "load_trainer_params",
+    "manifest_specs",
+    "mesh_desc",
+    "mesh_shape_of",
+    "params_template",
+    "payload_shardings",
+    "repartition",
+    "resolve_lm_state_specs",
+    "serving_param_shardings",
+    "spec_for_path",
+]

@@ -1,0 +1,185 @@
+"""Elastic checkpoint reader: any format, any target mesh.
+
+``load_elastic`` is the one restore entry point that accepts every
+checkpoint this framework can produce — sharded multi-shard directories,
+legacy single-file msgpack blobs, and whatever ``restorable_paths`` falls
+back to after a torn save — and places it onto whatever mesh the caller
+is running on NOW:
+
+- sharded directories go through ``utils.checkpoint.ManifestReader``:
+  each addressable shard of each target leaf is assembled from exactly
+  the manifest blocks that overlap it and ``device_put`` slice-wise via
+  ``make_array_from_callback`` — no process ever materializes a full
+  global copy of a sharded leaf, whether or not the writer's block
+  layout matches the target sharding;
+- legacy single files have no block table (one msgpack blob), so the
+  full host array is unavoidable — but placement is still slice-wise:
+  each device receives a zero-copy VIEW of its shard, not a second copy;
+- the writer's topology (recorded in the manifest since round 9) is
+  compared against the target mesh, and a mismatch is surfaced as
+  ``RestoreInfo.resharded`` — logged by the trainers, gateable via
+  ``allow_reshard=False`` (``ReshardRefused``) for operators who want
+  same-topology-only restores.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Mapping, Optional
+
+import jax
+import numpy as np
+
+from pytorch_distributed_tpu.utils.checkpoint import (
+    ManifestReader,
+    load_checkpoint,
+)
+
+
+class ReshardRefused(RuntimeError):
+    """The checkpoint was written on a different mesh shape and the
+    caller disabled elastic restore (``allow_reshard=False``)."""
+
+
+@dataclasses.dataclass
+class RestoreInfo:
+    """What one elastic restore actually did."""
+
+    path: str
+    format: str  # "sharded" | "legacy"
+    source_mesh: Optional[dict] = None  # writer topology, if recorded
+    target_mesh: Optional[dict] = None
+    resharded: bool = False  # writer and target topologies differ
+    exact_blocks: int = 0  # regions served by the no-copy fast path
+    assembled_regions: int = 0  # regions stitched from partial overlaps
+    bytes_assembled: int = 0
+
+    def describe(self) -> str:
+        src = mesh_desc(self.source_mesh) if self.source_mesh else "unknown"
+        tgt = mesh_desc(self.target_mesh) if self.target_mesh else "host"
+        return (
+            f"{self.format} checkpoint [{src}] -> [{tgt}]"
+            + (f", resharded ({self.exact_blocks} exact blocks, "
+               f"{self.assembled_regions} assembled regions)"
+               if self.resharded else "")
+        )
+
+
+def mesh_shape_of(mesh) -> dict:
+    """``{"axes": [...], "shape": [...]}`` of a live Mesh — the same
+    metadata the sharded save records in its manifest."""
+    return {
+        "axes": [str(a) for a in mesh.axis_names],
+        "shape": [int(mesh.shape[a]) for a in mesh.axis_names],
+    }
+
+
+def mesh_desc(meta) -> str:
+    """Human form: ``data=4 seq=1 model=2`` (accepts a Mesh or the
+    manifest's ``{"axes", "shape"}`` mapping)."""
+    if hasattr(meta, "axis_names"):
+        meta = mesh_shape_of(meta)
+    return " ".join(
+        f"{a}={s}" for a, s in zip(meta["axes"], meta["shape"])
+    )
+
+
+def _meshes_differ(src: Optional[Mapping], tgt: Optional[Mapping]) -> bool:
+    if src is None or tgt is None:
+        return False  # unknown writer topology: never claim a reshard
+    return dict(zip(src["axes"], src["shape"])) != dict(
+        zip(tgt["axes"], tgt["shape"])
+    )
+
+
+def checkpoint_mesh(path: str | os.PathLike) -> Optional[dict]:
+    """Writer topology of a sharded checkpoint directory, or None
+    (legacy single file / pre-round-9 manifest)."""
+    if not os.path.isdir(os.fspath(path)):
+        return None
+    return ManifestReader(path).mesh_meta
+
+
+def _place_from_host(tree: Any, shardings: Any) -> Any:
+    """Slice-wise placement of a host-numpy tree: each addressable shard
+    gets a zero-copy view of its slice of the host array (the legacy
+    single-file analog of the block-table path — the full array already
+    exists on host, but no second full-size copy is made)."""
+
+    def place(leaf, sh):
+        if not isinstance(sh, jax.sharding.Sharding):
+            return leaf
+        arr = np.asarray(leaf)
+        if arr.ndim == 0:
+            return jax.device_put(arr, sh)
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx, arr=arr: arr[idx]
+        )
+
+    return jax.tree.map(place, tree, shardings)
+
+
+def load_elastic(
+    path: str | os.PathLike,
+    template: Any,
+    shardings: Any = None,
+    *,
+    mesh=None,
+    allow_reshard: bool = True,
+):
+    """Restore ``path`` (sharded dir or legacy file) into ``template``'s
+    structure, placed per ``shardings``. Returns ``(tree, RestoreInfo)``.
+
+    ``mesh`` (the target mesh, for topology comparison/logging) is
+    optional; without it ``resharded`` is inferred only when shardings
+    carry a NamedSharding. ``allow_reshard=False`` raises
+    :class:`ReshardRefused` when the writer topology is known and
+    differs — the caller (``try_resume``) treats that like any other
+    unusable candidate and falls through.
+    """
+    path = os.fspath(path)
+    target = mesh_shape_of(mesh) if mesh is not None else _infer_target(
+        shardings
+    )
+    if os.path.isdir(path):
+        reader = ManifestReader(path)
+        info = RestoreInfo(
+            path=path, format="sharded",
+            source_mesh=reader.mesh_meta, target_mesh=target,
+            resharded=_meshes_differ(reader.mesh_meta, target),
+        )
+        if info.resharded and not allow_reshard:
+            raise ReshardRefused(
+                f"{path} was written on mesh "
+                f"[{mesh_desc(info.source_mesh)}] but the run targets "
+                f"[{mesh_desc(target)}] and elastic_resume is disabled"
+            )
+        from pytorch_distributed_tpu.utils.checkpoint import load_sharded
+
+        tree = load_sharded(path, template, shardings, reader=reader)
+        info.exact_blocks = reader.exact_blocks
+        info.assembled_regions = reader.assembled_regions
+        info.bytes_assembled = reader.bytes_assembled
+        return tree, info
+
+    # Legacy single-file msgpack: structure-only template restore, then
+    # slice-wise placement. No block table -> no writer topology either;
+    # the restore is mesh-agnostic by construction (full global host
+    # arrays), so it can never be refused as a reshard.
+    tree = load_checkpoint(path, template)
+    if shardings is not None:
+        tree = _place_from_host(tree, shardings)
+    return tree, RestoreInfo(
+        path=path, format="legacy", target_mesh=target
+    )
+
+
+def _infer_target(shardings) -> Optional[dict]:
+    if shardings is None:
+        return None
+    for leaf in jax.tree.leaves(shardings):
+        mesh = getattr(leaf, "mesh", None)
+        if getattr(mesh, "axis_names", None):
+            return mesh_shape_of(mesh)
+    return None

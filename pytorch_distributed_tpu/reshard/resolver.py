@@ -1,0 +1,185 @@
+"""Sharding resolution from the partition-rule tables — mesh-agnostic.
+
+The whole point of elastic resume is that target shardings are derived
+from the RULES the trainers already own, never from the layout the
+checkpoint writer happened to use (SNIPPETS.md [1], the EasyLM/levanter
+``match_partition_rules`` pattern). Two resolution modes live here:
+
+- **live-state**: ``resolve_lm_state_specs`` produces the TrainState-shaped
+  spec tree exactly the way ``train.lm.lm_state_specs`` (+ the FSDP
+  overlay) does — one delegation point, so resolver and trainer placement
+  cannot drift;
+- **path-based**: ``spec_for_path``/``manifest_specs`` resolve a
+  PartitionSpec from a manifest leaf path + shape alone — no live model,
+  no devices, no mesh object. This is what lets ``scripts/reshard.py``
+  repartition a checkpoint offline for a target topology that may not
+  even be attachable from this host: a "mesh" is just an
+  ``{axis: size}`` mapping.
+
+Path-based resolution leans on two structural facts: (1) the TP/EP/vocab
+rules match with ``re.search``, and every optimizer-state copy of a
+parameter carries the full parameter path as a suffix
+(``state/opt_state/0/mu/block0/attn/qkv/kernel``), so one rule claims the
+parameter AND its moments; (2) the FSDP overlay is pure shape arithmetic
+(largest data-axis-divisible dim of big-enough unclaimed leaves —
+``parallel.fsdp.fsdp_dim``). ``analysis/partition_coverage.py`` proves at
+lint time that every shardable parameter is claimed by a rule, which is
+what makes rule-derived resolution complete; ``assert_rules_cover`` runs
+that same check on demand.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_tpu.parallel.mesh import DATA_AXIS
+
+# Only these payload subtrees hold rule-governed (and FSDP-shardable)
+# arrays; everything else — batch_stats, scaler, step, host scalars —
+# is replicated by design, exactly as lm_state_specs/fsdp_state_specs
+# leave them.
+RULE_SCOPES = ("state/params/", "state/opt_state/")
+
+
+def lm_rules(config=None) -> Tuple:
+    """The LM trainers' full rule list for ``config``: the Megatron TP
+    table plus the conditional MoE/vocab-parallel placements — the same
+    composition ``lm_state_specs`` performs."""
+    from pytorch_distributed_tpu.train import lm as lm_mod
+
+    rules = lm_mod.TRANSFORMER_TP_RULES
+    if config is not None and getattr(config, "n_experts", 0):
+        rules = rules + lm_mod._moe_rules(config)
+    if lm_mod._uses_vocab_parallel(config):
+        rules = rules + lm_mod._vocab_rules(config)
+    return rules
+
+
+def resolve_lm_state_specs(state, mesh: Mesh, config=None,
+                           fsdp: bool = False):
+    """TrainState-shaped PartitionSpec tree for ``state`` on ``mesh`` —
+    the one the LM trainer would use: TP/EP/vocab rules, optimizer state
+    following its parameters, optional ZeRO overlay."""
+    from pytorch_distributed_tpu.train.lm import (
+        _overlay_fsdp_specs,
+        lm_state_specs,
+    )
+
+    specs = lm_state_specs(state, config=config)
+    if fsdp:
+        specs = _overlay_fsdp_specs(specs, state, mesh, config)
+    return specs
+
+
+def payload_shardings(mesh: Mesh, template: Any, state_specs=None) -> Any:
+    """Template-shaped shardings tree for a trainer checkpoint payload:
+    the ``state`` subtree gets NamedShardings (from ``state_specs``, or
+    fully replicated when None — the non-FSDP image trainer), every other
+    entry (epoch/step/best_* host scalars) gets False so the loader
+    returns plain numpy for them."""
+    from pytorch_distributed_tpu.parallel import mesh as mesh_lib
+
+    if state_specs is not None:
+        state_sh = mesh_lib.specs_to_shardings(mesh, state_specs)
+    else:
+        state_sh = jax.tree.map(
+            lambda _: mesh_lib.replicated_sharding(mesh), template["state"]
+        )
+    shardings = {k: jax.tree.map(lambda _: False, v)
+                 for k, v in template.items() if k != "state"}
+    shardings["state"] = state_sh
+    return shardings
+
+
+def _spec_effective(spec: P, mesh_shape: Mapping[str, int]) -> bool:
+    """A matched rule only CLAIMS a path when some named axis has size > 1
+    (on tp=1 meshes the Megatron specs are vacuous and leaves correctly
+    fall through to the FSDP overlay) — mirrors ``train.lm._rule_claimed``
+    for ``{axis: size}`` mappings."""
+    from pytorch_distributed_tpu.ops.optim import spec_axes
+
+    return any(int(mesh_shape.get(a, 1)) > 1 for a in spec_axes(spec))
+
+
+def spec_for_path(
+    path: str,
+    shape: Sequence[int],
+    rules: Sequence[Tuple[str, P]],
+    mesh_shape: Mapping[str, int],
+    fsdp: bool = False,
+    data_axis: str = DATA_AXIS,
+) -> P:
+    """PartitionSpec for one manifest leaf, from its path + shape alone.
+
+    Resolution order mirrors the live spec builders exactly: scalar or
+    out-of-scope (non-params/opt) paths are replicated; the first rule
+    whose regex matches the path wins when it effectively shards
+    something on this mesh shape; otherwise the FSDP overlay (when
+    enabled) shards the largest data-axis-divisible dimension of
+    big-enough leaves; everything else replicates.
+    """
+    from pytorch_distributed_tpu.parallel.fsdp import fsdp_dim
+
+    shape = tuple(int(d) for d in shape)
+    if not shape or not any(path.startswith(s) for s in RULE_SCOPES):
+        return P()
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            if _spec_effective(spec, mesh_shape):
+                if len(spec) > len(shape):
+                    raise ValueError(
+                        f"rule {pattern!r} spec {spec} has more dims than "
+                        f"leaf {path!r} {shape} — rule/table drift"
+                    )
+                return spec
+            break  # matched but vacuous on this mesh: overlay may claim it
+    if fsdp:
+        d = fsdp_dim(shape, int(mesh_shape.get(data_axis, 1)))
+        if d is not None and int(mesh_shape.get(data_axis, 1)) > 1:
+            return P(*(data_axis if i == d else None
+                       for i in range(len(shape))))
+    return P()
+
+
+def manifest_specs(
+    manifest: Mapping[str, Any],
+    mesh_shape: Mapping[str, int],
+    rules: Optional[Sequence[Tuple[str, P]]] = None,
+    config=None,
+    fsdp: bool = False,
+) -> dict:
+    """``{leaf_path: PartitionSpec}`` for every leaf of a sharded
+    checkpoint manifest, resolved for a target ``{axis: size}`` mesh
+    shape (no devices needed). ``rules=None`` uses the LM tables for
+    ``config`` (``lm_rules``); pass ``rules=()`` for rule-free models
+    (the image trainer: FSDP overlay or plain replication)."""
+    if rules is None:
+        rules = lm_rules(config)
+    return {
+        path: spec_for_path(path, meta["shape"], rules, mesh_shape,
+                            fsdp=fsdp)
+        for path, meta in manifest["leaves"].items()
+    }
+
+
+def assert_rules_cover() -> None:
+    """Run ``analysis.partition_coverage`` and raise if any shardable
+    parameter falls through the rule tables (or a rule is dead) — the
+    lint-time proof that rule-derived target shardings are complete,
+    callable at reshard time (``scripts/reshard.py --check``)."""
+    from pytorch_distributed_tpu.analysis.partition_coverage import (
+        check_partition_coverage,
+    )
+
+    findings = check_partition_coverage()
+    if findings:
+        raise RuntimeError(
+            "partition-rule coverage failed — rule-derived reshard "
+            "targets would be incomplete:\n" + "\n".join(
+                f.message for f in findings
+            )
+        )

@@ -1,0 +1,135 @@
+"""Serving-side elastic load: trainer checkpoints at ANY TP degree.
+
+Training and serving rarely agree on topology — a dp4×tp2 trainer
+checkpoint typically feeds tp1 single-chip replicas, or a tp4 serving
+mesh sized for latency. Parameter shapes are GLOBAL in every layout, so
+the only real work is (1) pulling the ``state/params`` subtree out of a
+trainer checkpoint (sharded dir or legacy single file — the payload also
+carries opt state, RNG-free by design, and host scalars serving never
+needs) and (2) resolving placements from the serving rule table
+(``models.generate._tp_rules`` — the same Megatron layout the trainer
+rules express, remapped to the config's axis name) instead of from the
+writer's layout. The block-table reader then feeds each serving shard
+exactly its slices; the optimizer moments (usually 2/3 of the
+checkpoint's bytes) are never read at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.reshard.reader import RestoreInfo, mesh_shape_of
+
+
+def params_template(config) -> Any:
+    """ShapeDtypeStruct params tree for ``config`` — ``jax.eval_shape``
+    through the same dense init twin ``create_lm_state`` uses (global
+    shapes are identical across parallel layouts), so no FLOPs and no
+    device memory."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+    init_cfg = dataclasses.replace(
+        config, attention="dense", model_axis=None, tp_size=1,
+        expert_axis=None, ep_size=1, ring_layout="contiguous",
+    )
+    model = TransformerLM(init_cfg)
+    return jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+
+
+def serving_param_shardings(config, mesh, params_like) -> Any:
+    """NamedSharding tree for serving params on ``mesh``, resolved from
+    the serving TP rule table at the CONFIG's degree — never from the
+    checkpoint writer's layout."""
+    from jax.sharding import NamedSharding
+
+    from pytorch_distributed_tpu.models.generate import _tp_rules
+    from pytorch_distributed_tpu.parallel.tensor import match_partition_rules
+
+    specs = match_partition_rules(_tp_rules(config), params_like)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+
+def load_trainer_params(
+    path: str | os.PathLike,
+    config,
+    mesh=None,
+) -> Tuple[Any, RestoreInfo]:
+    """Load the parameter tree of a trainer checkpoint for serving under
+    ``config``. Returns ``(params, RestoreInfo)``.
+
+    ``mesh=None`` (replicated / single-chip serving, or letting the
+    engine place): host numpy leaves. With a mesh (TP serving), each
+    leaf is placed slice-wise per the serving rules at ``config``'s TP
+    degree — whatever degree the trainer ran at.
+    """
+    path = os.fspath(path)
+    template = params_template(config)
+    shardings = (
+        serving_param_shardings(config, mesh, template)
+        if mesh is not None else None
+    )
+
+    if os.path.isdir(path):
+        from pytorch_distributed_tpu.reshard.reader import load_elastic
+
+        tree, info = load_elastic(
+            # the template names ONLY state/params/* leaves, so the
+            # reader never touches the optimizer-moment blocks
+            path,
+            {"state": {"params": template}},
+            None if shardings is None else {"state": {"params": shardings}},
+            mesh=mesh,
+        )
+        params = tree["state"]["params"]
+    else:
+        from flax import serialization
+
+        with open(path, "rb") as f:
+            sd = serialization.msgpack_restore(f.read())
+        try:
+            sub = sd["state"]["params"]
+        except (KeyError, TypeError):
+            raise KeyError(
+                f"{path} has no state/params subtree — not a trainer "
+                "checkpoint payload"
+            )
+        params = serialization.from_state_dict(template, sub)
+        if shardings is not None:
+            from pytorch_distributed_tpu.reshard.reader import (
+                _place_from_host,
+            )
+
+            params = _place_from_host(params, shardings)
+        info = RestoreInfo(
+            path=path, format="legacy",
+            target_mesh=mesh_shape_of(mesh) if mesh is not None else None,
+        )
+
+    _check_shapes(params, template, path)
+    return params, info
+
+
+def _check_shapes(params, template, path) -> None:
+    """Config/checkpoint drift (wrong vocab size, layer count edits)
+    surfaces as a shape mismatch here with the leaf named — not as an
+    XLA error three calls later."""
+    for (p, got), (_, want) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(template),
+    ):
+        got_shape = tuple(np.shape(got))
+        if got_shape != tuple(want.shape):
+            raise ValueError(
+                f"checkpoint {path} leaf {jax.tree_util.keystr(p)} has "
+                f"shape {got_shape}, serving config expects "
+                f"{tuple(want.shape)} — config/checkpoint mismatch"
+            )

@@ -325,37 +325,56 @@ class SuspendableTrainer:
         reference lacks, so a crash after them must not fall back to an
         older suspend artifact).
 
+        ELASTIC (reshard/; ROADMAP item 4): target shardings come from
+        THIS run's mesh and spec tree, never from the writer's layout, so
+        a checkpoint written on mesh (4,2) restores onto (2,2) or (8,1)
+        with optimizer state, data cursor and global step intact — each
+        process assembles exactly the block slices its devices need.
+        ``config.elastic_resume=False`` refuses topology-mismatched
+        candidates instead (they fall through like corrupt ones). A
+        cross-topology resume changes ``run_fingerprint`` (the mesh is
+        part of it), so the writer's compile-cache artifacts are misses
+        by construction; ``fit()`` runs ``_run_warmup`` AFTER this
+        method, which re-AOT-compiles the registry for the new mesh
+        before step 1 — no mid-run compiles after an elastic resume.
+
         Fallback restore: candidates are pre-validated (manifest + shard
         completeness + save token) and scanned newest-first; a candidate
         that still fails at load time — e.g. a token mismatch surfacing
         mid-read — is logged and the scan falls through to the next
         *complete* checkpoint instead of refusing to start. Validation
         reads the same shared-fs files on every rank, so all ranks pick
-        the same candidate.
-
-        Sharded directories restore shard-wise (each process reads only the
-        blocks its devices need); legacy single files restore via the old
-        full-numpy path."""
-        from pytorch_distributed_tpu.utils.checkpoint import (
-            load_checkpoint,
-            load_sharded,
+        the same candidate. Legacy single files restore via the full-
+        host-numpy path, placed slice-wise — mesh-agnostic by
+        construction."""
+        from pytorch_distributed_tpu.reshard import (
+            ReshardRefused,
+            load_elastic,
+            mesh_desc,
+            payload_shardings,
         )
 
         self.ckpt.wait()
+        allow = getattr(self.config, "elastic_resume", True)
         for path in self.ckpt.restorable_paths():
             try:
-                if os.path.isdir(path):
-                    template = self._payload_live(0, 0)
-                    state_sh = self._state_shardings()
-                    shardings = jax.tree.map(lambda _: False, template)
-                    shardings["state"] = state_sh
-                    restored = load_sharded(path, template, shardings)
-                    state = jax.device_put(restored["state"], state_sh)
-                else:
-                    restored = load_checkpoint(path, self._payload(0, 0))
-                    state = jax.device_put(
-                        restored["state"], self._state_shardings()
-                    )
+                template = self._payload_live(0, 0)
+                shardings = payload_shardings(
+                    self.mesh, template, self.state_specs
+                )
+                restored, info = load_elastic(
+                    path, template, shardings,
+                    mesh=self.mesh, allow_reshard=allow,
+                )
+                # no-op for placed sharded leaves; places the legacy
+                # path's host arrays (slice-wise put already done there,
+                # this is belt-and-braces for sharding-less entries)
+                state = jax.device_put(
+                    restored["state"], shardings["state"]
+                )
+            except ReshardRefused as e:
+                rank0_print(f"resume: skipping {path}: {e}")
+                continue
             except (OSError, ValueError, KeyError, RuntimeError) as e:
                 rank0_print(
                     f"resume: {path} failed to load ({e}); falling back "
@@ -366,6 +385,13 @@ class SuspendableTrainer:
             self.start_epoch = int(restored["epoch"])
             self.start_step = int(restored["step"])
             self._restore_extra(restored)
+            if info.resharded:
+                rank0_print(
+                    f"elastic resume: {info.describe()} — "
+                    "run_fingerprint changed with the mesh; warmup "
+                    "re-AOT-compiles the program registry for this "
+                    "topology before step 1"
+                )
             rank0_print(
                 f"resumed from {path}: "
                 f"epoch {self.start_epoch} step {self.start_step}"

@@ -157,6 +157,11 @@ class LMTrainerConfig:
     # records in the metrics JSONL.
     compile_cache_dir: Optional[str] = None
     warmup: bool = False
+    # Elastic resume — see TrainerConfig: a run killed on mesh (4,2)
+    # resumes on (2,2) or (8,1) (TP/FSDP state re-partitioned from the
+    # rule tables, optimizer moments included); False = same-topology
+    # restores only.
+    elastic_resume: bool = True
 
 
 class LMTrainer(SuspendableTrainer):

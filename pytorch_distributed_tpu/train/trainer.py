@@ -114,6 +114,13 @@ class TrainerConfig:
     # first step (ledger compile attribution + kind="warmup" manifest).
     compile_cache_dir: Optional[str] = None
     warmup: bool = False
+    # Elastic resume (reshard/, ANALYSIS.md "Elastic topology & reshard"):
+    # restore checkpoints written on a DIFFERENT mesh shape by resolving
+    # target shardings from this run's spec tree and assembling each
+    # device's slices from the manifest block table — preemption can hand
+    # back any topology. False refuses topology-mismatched candidates
+    # (they fall through to older same-topology checkpoints).
+    elastic_resume: bool = True
 
 
 class Trainer(SuspendableTrainer):

@@ -175,6 +175,23 @@ def _check_unique_paths(paths, where: str) -> None:
         )
 
 
+def _payload_mesh_meta(leaves) -> Optional[dict]:
+    """``{"axes": [...], "shape": [...]}`` of the mesh the payload's
+    arrays live on (the first ``NamedSharding`` leaf wins — one payload is
+    placed on one mesh), or None for host-only payloads. Recorded in the
+    manifest so a restore onto a different topology is detectable."""
+    for leaf in leaves:
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        axis_names = getattr(mesh, "axis_names", None)
+        if axis_names:
+            return {
+                "axes": [str(a) for a in axis_names],
+                "shape": [int(mesh.shape[a]) for a in axis_names],
+            }
+    return None
+
+
 def _canonical_blocks(x: jax.Array):
     """Deterministic global block layout of a jax.Array: one canonical
     owner device per distinct index tuple. Ownership round-robins over the
@@ -298,6 +315,7 @@ class _ShardedSave:
 
         paths, leaves, _ = _tree_paths(payload)
         _check_unique_paths(paths, "save_sharded")
+        mesh_meta = _payload_mesh_meta(leaves)
 
         # Pass 1 — metadata only: block layout + manifest + the list of
         # local blocks to snapshot (no copies yet).
@@ -361,6 +379,12 @@ class _ShardedSave:
                 "blocks": blocks,
             }
         manifest["token"] = token
+        if mesh_meta is not None:
+            # writer topology, for elastic resume: lets a restore onto a
+            # DIFFERENT mesh shape announce itself (reshard/) and lets
+            # tools refuse/permit cross-topology restores explicitly.
+            # Absent for host-only payloads and pre-round-9 checkpoints.
+            manifest["mesh"] = mesh_meta
         self.manifest = manifest
 
         # Pass 2 — SNAPSHOT: one bulk copy of every local block into a
@@ -619,35 +643,58 @@ class _RawNpz:
             return self._np_fallback[key]
 
 
-def load_sharded(
-    dirpath: str | os.PathLike, template: Any, shardings: Any = None
-) -> Any:
-    """Restore a ``save_sharded`` directory into ``template``'s structure.
+class ManifestReader:
+    """Block-table access to one sharded checkpoint directory.
 
-    With a ``shardings`` pytree (template-shaped, leaves
-    ``jax.sharding.Sharding`` or None), array leaves are built with
-    ``jax.make_array_from_callback`` reading ONLY the blocks overlapping
-    each local device shard — no process assembles a full copy of a
-    sharded leaf. Without it, leaves come back as full numpy (the
-    single-process / legacy-compatible path). Reads go through an
-    mmap-backed zero-copy zip reader (``_RawNpz``) with a per-region
-    cache, so replicated leaves aren't re-read once per device.
+    The engine behind :func:`load_sharded` and the ``reshard/`` subsystem:
+    parses the manifest once, opens shard files through the mmap-backed
+    zero-copy zip reader (``_RawNpz``, with the ``np.load`` fall-through
+    and save-token verification), and assembles ANY ``[start, stop)``
+    region of any leaf from the blocks that overlap it — the primitive
+    that makes restore independent of the mesh that wrote the checkpoint.
+    Regions are cached (``make_array_from_callback`` asks once per
+    addressable device; replicated leaves repeat identical regions).
+
+    Counters (for restore telemetry / the reshard bench): ``exact_blocks``
+    regions served by the no-copy exact-match fast path,
+    ``assembled_regions`` regions stitched from partially-overlapping
+    blocks, ``bytes_assembled`` copied in doing so.
     """
-    import json
 
-    import jax.tree_util as jtu
+    def __init__(self, dirpath: str | os.PathLike):
+        import json
 
-    dirpath = os.fspath(dirpath)
-    with open(os.path.join(dirpath, MANIFEST)) as f:
-        manifest = json.load(f)
+        self.dirpath = os.fspath(dirpath)
+        with open(os.path.join(self.dirpath, MANIFEST)) as f:
+            self.manifest = json.load(f)
+        self.token = self.manifest.get("token")
+        self._shard_cache: dict[str, Any] = {}
+        self._region_cache: dict = {}
+        self.exact_blocks = 0
+        self.assembled_regions = 0
+        self.bytes_assembled = 0
 
-    shard_cache: dict[str, Any] = {}
+    @property
+    def mesh_meta(self) -> Optional[dict]:
+        """Writer topology ``{"axes": [...], "shape": [...]}`` or None
+        (host-only payload / pre-round-9 checkpoint)."""
+        return self.manifest.get("mesh")
 
-    token = manifest.get("token")
+    def leaf_paths(self) -> list:
+        return list(self.manifest.get("leaves", {}))
 
-    def _file(fname):
-        if fname not in shard_cache:
-            fpath = os.path.join(dirpath, fname)
+    def leaf_meta(self, path: str) -> dict:
+        meta = self.manifest.get("leaves", {}).get(path)
+        if meta is None:
+            raise KeyError(
+                f"checkpoint at {self.dirpath} has no leaf {path!r}; the "
+                "template's structure must match the saved payload"
+            )
+        return meta
+
+    def _file(self, fname):
+        if fname not in self._shard_cache:
+            fpath = os.path.join(self.dirpath, fname)
             try:
                 npz = _RawNpz(fpath)
             except OSError:
@@ -660,29 +707,44 @@ def load_sharded(
             except Exception:
                 # NpzFile is lazy: only members actually accessed are read
                 npz = np.load(fpath, allow_pickle=False)
-            if token is not None:
+            if self.token is not None:
                 got = bytes(np.asarray(npz["__token__"]).tobytes()).hex()
-                if got != token:
+                if got != self.token:
                     raise RuntimeError(
-                        f"torn checkpoint at {dirpath}: {fname} belongs to "
-                        f"save {got}, manifest says {token} — a crash "
-                        "interrupted a save; restore an older checkpoint"
+                        f"torn checkpoint at {self.dirpath}: {fname} "
+                        f"belongs to save {got}, manifest says "
+                        f"{self.token} — a crash interrupted a save; "
+                        "restore an older checkpoint"
                     )
-            shard_cache[fname] = npz
-        return shard_cache[fname]
+            self._shard_cache[fname] = npz
+        return self._shard_cache[fname]
 
-    def _read_region(meta, start, stop):
-        """Assemble [start, stop) of a leaf from overlapping blocks."""
+    def _block(self, meta, b) -> np.ndarray:
+        bshape = [e - s for s, e in zip(b["start"], b["stop"])]
+        return (
+            self._file(b["file"])[b["key"]]
+            .view(np.dtype(meta["dtype"]))
+            .reshape(bshape)
+        )
+
+    def read_region(self, path: str, start, stop) -> np.ndarray:
+        """Assemble ``[start, stop)`` of leaf ``path`` from overlapping
+        blocks (cached). Exact block matches are zero-copy mmap views —
+        READ-ONLY; callers handing arrays out unsharded must copy."""
+        key = (path, tuple(start), tuple(stop))
+        if key not in self._region_cache:
+            self._region_cache[key] = self._read_region(
+                self.leaf_meta(path), start, stop
+            )
+        return self._region_cache[key]
+
+    def _read_region(self, meta, start, stop):
         for b in meta["blocks"]:
             if b["start"] == list(start) and b["stop"] == list(stop):
-                # exact-match fast path (same sharding at restore): no
-                # assembly copy
-                bshape = [e - s for s, e in zip(b["start"], b["stop"])]
-                return (
-                    _file(b["file"])[b["key"]]
-                    .view(np.dtype(meta["dtype"]))
-                    .reshape(bshape)
-                )
+                # exact-match fast path (the writer's sharding and the
+                # reader's agree on this region): no assembly copy
+                self.exact_blocks += 1
+                return self._block(meta, b)
         out = np.empty(
             [e - s for s, e in zip(start, stop)], np.dtype(meta["dtype"])
         )
@@ -691,12 +753,7 @@ def load_sharded(
             hi = [min(e, be) for e, be in zip(stop, b["stop"])]
             if any(l >= h for l, h in zip(lo, hi)):
                 continue
-            bshape = [e - s for s, e in zip(b["start"], b["stop"])]
-            block = (
-                _file(b["file"])[b["key"]]
-                .view(np.dtype(meta["dtype"]))
-                .reshape(bshape)
-            )
+            block = self._block(meta, b)
             src = tuple(
                 slice(l - bs, h - bs)
                 for l, h, bs in zip(lo, hi, b["start"])
@@ -705,7 +762,33 @@ def load_sharded(
                 slice(l - s, h - s) for l, h, s in zip(lo, hi, start)
             )
             out[dst] = block[src] if out.ndim else block
+        self.assembled_regions += 1
+        self.bytes_assembled += out.nbytes
         return out
+
+
+def load_sharded(
+    dirpath: str | os.PathLike, template: Any, shardings: Any = None,
+    reader: Optional[ManifestReader] = None,
+) -> Any:
+    """Restore a ``save_sharded`` directory into ``template``'s structure.
+
+    With a ``shardings`` pytree (template-shaped, leaves
+    ``jax.sharding.Sharding`` or None), array leaves are built with
+    ``jax.make_array_from_callback`` reading ONLY the blocks overlapping
+    each local device shard — no process assembles a full copy of a
+    sharded leaf, whether or not the target sharding matches the layout
+    the writer used (cross-mesh restores stitch partially-overlapping
+    blocks per shard; ``reshard/``). Without it, leaves come back as full
+    numpy (the single-process / legacy-compatible path). Reads go through
+    :class:`ManifestReader` (mmap-backed zero-copy zip access with a
+    per-region cache); pass ``reader`` to reuse one across calls or to
+    harvest its exact/assembled counters afterwards.
+    """
+    import jax.tree_util as jtu
+
+    if reader is None:
+        reader = ManifestReader(dirpath)
 
     paths, t_leaves, treedef = _tree_paths(template)
     _check_unique_paths(paths, "load_sharded")
@@ -714,39 +797,23 @@ def load_sharded(
     else:
         s_paths, s_leaves, _ = _tree_paths(shardings)
 
-    # make_array_from_callback invokes the callback once per addressable
-    # device; replicated / partially-replicated leaves repeat identical
-    # (start, stop) regions — serve those from a cache, not a re-read.
-    region_cache: dict = {}
-
-    def _read_region_cached(path, meta, start, stop):
-        key = (path, tuple(start), tuple(stop))
-        if key not in region_cache:
-            region_cache[key] = _read_region(meta, start, stop)
-        return region_cache[key]
-
     restored = []
     for path, tleaf, sleaf in zip(paths, t_leaves, s_leaves):
-        meta = manifest["leaves"].get(path)
-        if meta is None:
-            raise KeyError(
-                f"checkpoint at {dirpath} has no leaf {path!r}; the "
-                "template's structure must match the saved payload"
-            )
+        meta = reader.leaf_meta(path)
         shape = tuple(meta["shape"])
         if isinstance(sleaf, jax.sharding.Sharding) and shape:
             arr = jax.make_array_from_callback(
                 shape, sleaf,
-                lambda idx, path=path, meta=meta, shape=shape:
-                _read_region_cached(
-                    path, meta,
+                lambda idx, path=path, shape=shape:
+                reader.read_region(
+                    path,
                     [sl.start or 0 for sl in idx],
                     [sl.stop if sl.stop is not None else d
                      for sl, d in zip(idx, shape)],
                 ),
             )
         else:
-            arr = _read_region(meta, [0] * len(shape), list(shape))
+            arr = reader.read_region(path, [0] * len(shape), list(shape))
             if not arr.flags.writeable:
                 # _RawNpz exact-match views are read-only mmap windows;
                 # arrays handed to the caller unsharded must own their
@@ -1123,10 +1190,20 @@ class Checkpointer:
     def save_best(self, payload: Any) -> None:
         save_checkpoint(self.best_path, payload)
 
-    def load_latest(self, template: Any) -> Any:
+    def load_latest(self, template: Any, shardings: Any = None) -> Any:
+        """Same signature as ``load_latest_sharded``/``load_best``: the
+        ``shardings`` pytree reaches the sharded reader, so callers get
+        placed ``jax.Array`` leaves instead of full-host numpy. (Before
+        round 9 this method simply didn't accept the argument — callers
+        that passed one to the sibling loaders and then switched to
+        ``load_latest`` silently lost their placement and materialized
+        the whole state on host.) The legacy single-file branch restores
+        host numpy regardless — one msgpack blob has no block table —
+        and the caller re-places it (``reshard.load_elastic`` does the
+        slice-wise placement when given shardings)."""
         self.wait()
         if self.latest_is_sharded():
-            return load_sharded(self.latest_path, template)
+            return load_sharded(self.latest_path, template, shardings)
         return load_checkpoint(self.latest_path, template)
 
     def load_best(self, template: Any, shardings: Any = None) -> Any:

@@ -23,6 +23,14 @@ feed it to ``scripts/telemetry_report.py`` for TTFT/per-token p50/p95;
 ``--trace-dir DIR`` writes the host span Chrome trace
 (admission/prefill_chunk/decode_tick) to ``DIR/spans.trace.json``.
 
+Elastic load (round 9; ANALYSIS.md "Elastic topology & reshard"):
+``--restore CKPT`` serves a TRAINER checkpoint — sharded directory or
+legacy single file, written on ANY mesh shape — with the params
+re-partitioned from the serving rule table at ``--tp N``'s degree
+(reading only the params blocks, never the optimizer moments):
+
+    python recipes/serve_lm.py --tiny --restore out_lm/latest.ckpt --tp 2
+
 Cold start (round 8; ANALYSIS.md "Cold start & compile cache"):
 ``--warmup`` compiles every registry program (decode tick + all prefill
 buckets) before admitting traffic, and ``--compile-cache-dir`` points
@@ -98,21 +106,48 @@ def _parse() -> argparse.Namespace:
                    help="compile every registry program (decode tick + "
                         "all prefill buckets) before admitting traffic — "
                         "zero cold requests; paged layout only")
+    # Elastic load (reshard/; ANALYSIS.md "Elastic topology & reshard"):
+    # serve a TRAINER checkpoint at whatever TP degree this fleet runs —
+    # the params are re-partitioned from the serving rule table, never
+    # from the layout the trainer saved (a dp4xtp2 training checkpoint
+    # serves on tp1 single-chip replicas or a tp4 latency mesh alike).
+    p.add_argument("--restore", default=None, metavar="CKPT",
+                   help="load model params from a trainer checkpoint "
+                        "(sharded dir or legacy single file) instead of "
+                        "random init — any writer topology")
+    p.add_argument("--tp", type=int, default=1,
+                   help="serving tensor-parallel degree (needs that many "
+                        "devices; params are placed per the serving TP "
+                        "rules at THIS degree, whatever degree wrote the "
+                        "checkpoint)")
     return p.parse_args()
 
 
 def _model(args):
+    tp = dict(model_axis="model", tp_size=args.tp) if args.tp > 1 else {}
     if args.tiny or jax.default_backend() == "cpu":
-        cfg = tiny_config(attention="dense", max_seq_len=128)
+        cfg = tiny_config(attention="dense", max_seq_len=128, **tp)
     else:
         cfg = TransformerConfig(
             vocab_size=32_000, num_layers=12, num_heads=12, embed_dim=768,
-            max_seq_len=2048, attention="dense", dropout=0.0,
+            max_seq_len=2048, attention="dense", dropout=0.0, **tp,
         )
+    mesh = None
+    if args.tp > 1:
+        from pytorch_distributed_tpu.parallel import make_mesh
+
+        mesh = make_mesh(jax.devices()[: args.tp], data_parallel=1,
+                         seq_parallel=1, model_parallel=args.tp)
+    if args.restore:
+        from pytorch_distributed_tpu.reshard import load_trainer_params
+
+        params, info = load_trainer_params(args.restore, cfg, mesh=mesh)
+        rank0_print(f"restore: {info.describe()}")
+        return cfg, params, mesh
     params = TransformerLM(cfg).init(
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
-    return cfg, params
+    return cfg, params, mesh
 
 
 def _prompts(args, cfg):
@@ -135,7 +170,7 @@ def main() -> None:
 
         # before the model init below: its programs land in the cache too
         enable_persistent_cache(cache_dir)
-    cfg, params = _model(args)
+    cfg, params, mesh = _model(args)
     prompts = _prompts(args, cfg)
     from pytorch_distributed_tpu.telemetry import NULL_TRACER, SpanTracer
     from pytorch_distributed_tpu.utils.profiling import MetricsLogger
@@ -148,6 +183,9 @@ def main() -> None:
             raise SystemExit("--warmup needs the paged layout (the dense "
                              "ContinuousBatcher has no program registry); "
                              "drop --dense")
+        if args.tp > 1:
+            raise SystemExit("--tp > 1 needs the paged layout; drop "
+                             "--dense")
         # r4 layout: no queue — submit when a slot frees, the admission
         # itself copying the slot's full max_seq_len KV row
         b = ContinuousBatcher(
@@ -166,7 +204,7 @@ def main() -> None:
             cfg, params, n_slots=args.slots, block_len=args.block_len,
             prefill_chunk=args.prefill_chunk,
             admit_per_step=args.admit_per_step, seed=args.seed,
-            tracer=tracer, metrics_log=mlog,
+            mesh=mesh, tracer=tracer, metrics_log=mlog,
         )
         if args.warmup:
             # everything foreground + executed inert: the serve loop below

@@ -11,7 +11,15 @@ measured here dominates. Emits one JSON line:
   {"ckpt_params_m": ..., "ckpt_bytes_mb": ..., "ckpt_save_s": ...,
    "ckpt_restore_s": ..., "ckpt_mb_per_s": ...}
 
-Usage: python scripts/bench_checkpoint.py [--small]
+``--reshard`` appends the elastic-restore section (reshard/, ROADMAP
+item 4): the same dp4xtp2+FSDP checkpoint restored onto its own mesh
+(exact-block fast path) vs onto (2,1,2) and (8,1,1) (cross-topology
+block assembly), plus the offline repartition cost and the exact-path
+restore it buys — keys ``ckpt_reshard_*``. Runs on 8 virtual CPU
+devices (forced before jax import), so pass it on a dedicated
+invocation if you want the headline sections on default devices.
+
+Usage: python scripts/bench_checkpoint.py [--small] [--reshard]
 """
 
 from __future__ import annotations
@@ -24,6 +32,12 @@ import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--reshard" in sys.argv:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
@@ -123,6 +137,10 @@ def main() -> None:
         t0 = time.perf_counter()
         ck.wait()
         commit_s = time.perf_counter() - t0
+
+        reshard_keys = {}
+        if "--reshard" in sys.argv:
+            reshard_keys = _bench_reshard(d, cfg, tx, small)
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
@@ -140,7 +158,75 @@ def main() -> None:
         "ckpt_stall_max_s": round(max(stalls), 2),
         "ckpt_commit_after_overlap_s": round(commit_s, 2),
         "ckpt_mb_per_s": round(total_bytes / 2**20 / max(save_s, 1e-9), 1),
+        **reshard_keys,
     }))
+
+
+def _bench_reshard(d: str, cfg, tx, small: bool) -> dict:
+    """Elastic-restore timings: one dp4xtp2+FSDP checkpoint restored
+    onto three topologies, plus the offline repartition path."""
+    import dataclasses
+
+    from pytorch_distributed_tpu import reshard
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.train.lm import (
+        create_lm_state,
+        shard_lm_state,
+    )
+    from pytorch_distributed_tpu.utils.checkpoint import save_sharded
+
+    tp_cfg = dataclasses.replace(cfg, model_axis="model", tp_size=2)
+    state = create_lm_state(tp_cfg, tx, jax.random.key(1), init_len=64)
+    devs = jax.devices()
+
+    def mesh_of(dp, sp, mp):
+        return make_mesh(devs[: dp * sp * mp], data_parallel=dp,
+                         seq_parallel=sp, model_parallel=mp)
+
+    mesh_a = mesh_of(4, 1, 2)
+    placed, _ = shard_lm_state(mesh_a, state, tp_cfg, fsdp=True)
+    src = os.path.join(d, "reshard_src.ckpt")
+    save_sharded(src, {"state": placed, "epoch": 1, "step": 7,
+                       "best_ppl": 5.0})
+
+    def timed_restore(path, dp, sp, mp, target_cfg, fsdp):
+        mesh = mesh_of(dp, sp, mp)
+        specs = reshard.resolve_lm_state_specs(state, mesh, target_cfg,
+                                               fsdp=fsdp)
+        template = {"state": state, "epoch": 0, "step": 0, "best_ppl": 0.0}
+        shardings = reshard.payload_shardings(mesh, template, specs)
+        t0 = time.perf_counter()
+        back, info = reshard.load_elastic(path, template, shardings,
+                                          mesh=mesh)
+        jax.block_until_ready(jax.tree.leaves(back["state"].params))
+        return time.perf_counter() - t0, info
+
+    cfg1 = dataclasses.replace(cfg, model_axis=None, tp_size=1)
+    same_s, same_info = timed_restore(src, 4, 1, 2, tp_cfg, True)
+    to22_s, to22_info = timed_restore(src, 2, 1, 2, tp_cfg, True)
+    to81_s, _ = timed_restore(src, 8, 1, 1, cfg1, True)
+
+    dst = os.path.join(d, "reshard_22.ckpt")
+    t0 = time.perf_counter()
+    reshard.repartition(src, dst, {"data": 2, "seq": 1, "model": 2},
+                        config=tp_cfg, fsdp=True)
+    offline_s = time.perf_counter() - t0
+    pre_s, pre_info = timed_restore(dst, 2, 1, 2, tp_cfg, True)
+
+    return {
+        # same-mesh restore: every region exact-block (the r5 baseline)
+        "ckpt_reshard_same_mesh_s": round(same_s, 2),
+        "ckpt_reshard_same_assembled": same_info.assembled_regions,
+        # cross-topology elastic restores: block assembly on the fly
+        "ckpt_reshard_to_2x2_s": round(to22_s, 2),
+        "ckpt_reshard_to_2x2_assembled": to22_info.assembled_regions,
+        "ckpt_reshard_to_8x1_s": round(to81_s, 2),
+        # offline repartition + the exact-path restore it buys
+        "ckpt_reshard_offline_s": round(offline_s, 2),
+        "ckpt_reshard_prepartitioned_s": round(pre_s, 2),
+        "ckpt_reshard_prepartitioned_assembled":
+            pre_info.assembled_regions,
+    }
 
 
 if __name__ == "__main__":

@@ -6,7 +6,7 @@
 # hazards fail the build while the reviewed pre-existing ones don't.
 #
 # Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke|--serving-smoke|
-#                             --telemetry-smoke|--warmup-smoke]
+#                             --telemetry-smoke|--warmup-smoke|--reshard-smoke]
 #
 # --resilience-smoke: lint, then ONE crash-recovery cycle from the
 # kill-matrix (SIGKILL mid-shard-write → relaunch → assert resume) —
@@ -23,6 +23,13 @@
 # parse BOTH JSONLs and print a goodput breakdown + TTFT/per-token
 # p50/p95 (it exits non-zero otherwise) — the end-to-end proof the
 # observability pipeline (device ring → JSONL → report) still closes.
+#
+# --reshard-smoke: lint, then ONE cross-topology kill-and-resume cycle
+# (SIGKILL a run on mesh (4,1,2) mid-save, relaunch it on (2,1,2) at the
+# same global batch → elastic resume must reshard the checkpoint and
+# finish the run) — the cheap end-to-end proof that a preempted run can
+# resume on whatever topology the scheduler hands back, without the
+# full cross-topology kill matrix.
 #
 # --warmup-smoke: lint, then the compile-cache round trip: prewarm a tiny
 # LM serving registry into a fresh cache (scripts/warmup.py), re-run the
@@ -47,6 +54,14 @@ if [[ "${1:-}" == "--resilience-smoke" ]]; then
     JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
         -m crash -k shard_write -p no:cacheprovider -p no:xdist \
         -p no:randomly
+    exit 0
+fi
+
+if [[ "${1:-}" == "--reshard-smoke" ]]; then
+    echo "== reshard smoke (kill on mesh (4,2), elastic resume on (2,2)) =="
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_reshard.py::test_reshard_smoke_kill_and_cross_mesh_resume \
+        -q -p no:cacheprovider -p no:xdist -p no:randomly
     exit 0
 fi
 

@@ -38,7 +38,16 @@ def main() -> int:
     ap.add_argument("--save-dir", required=True)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--train-size", type=int, default=32)  # 2 steps/epoch
+    # elastic resume (round 9): mesh shape "data,seq,model" and a
+    # per-replica batch size, so a relaunch can resume the SAME save dir
+    # on a DIFFERENT topology at a fixed global batch (reshard/)
+    ap.add_argument("--mesh", default="8,1,1",
+                    help="data,seq,model axis sizes (devices used = "
+                    "their product)")
+    ap.add_argument("--batch-size", type=int, default=2,
+                    help="per-data-replica batch (global = bs x data)")
     args = ap.parse_args()
+    dp, sp, mp = (int(x) for x in args.mesh.split(","))
 
     from pytorch_distributed_tpu.data import SyntheticImageClassification
     from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
@@ -62,7 +71,7 @@ def main() -> int:
 
     cfg = TrainerConfig(
         epochs=args.epochs,
-        batch_size=2,  # ×8 replicas = global 16
+        batch_size=args.batch_size,  # default ×8 replicas = global 16
         lr=0.05,
         save_dir=args.save_dir,
         log_every=0,
@@ -80,7 +89,8 @@ def main() -> int:
         SyntheticImageClassification(size=16, image_size=16, num_classes=10,
                                      seed=1),
         cfg,
-        mesh=make_mesh(jax.devices()[:8]),
+        mesh=make_mesh(jax.devices()[: dp * sp * mp], data_parallel=dp,
+                       seq_parallel=sp, model_parallel=mp),
         input_shape=(1, 16, 16, 3),
     )
     resumed = trainer.try_resume()  # fit() re-runs this; it's idempotent
