@@ -375,6 +375,27 @@ def main() -> None:
             record.update(retry_transient(_serving, what="serving bench"))
         except Exception as e:
             record["serving_error"] = str(e)[:200]
+    if not tiny and os.environ.get("BENCH_FLEET", "1") == "1":
+        try:
+            import sys as _sys
+
+            _sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "scripts"))
+            import bench_serving
+
+            def _fleet():
+                # round-10 fleet A/Bs on the stock bursty heavy-tail
+                # trace: 1-vs-2-replica within-SLO goodput, colocated-
+                # vs-disaggregated decode tick p95 (tiny model — the
+                # router simulation measures scheduling, not FLOPs)
+                r = bench_serving.measure_fleet()
+                r.update(bench_serving.measure_disagg())
+                r.pop("device", None)
+                return r
+
+            record.update(retry_transient(_fleet, what="fleet bench"))
+        except Exception as e:
+            record["fleet_error"] = str(e)[:200]
     if not tiny and os.environ.get("BENCH_FP32", "1") == "1":
         fp32_bs = batch_size
         while True:

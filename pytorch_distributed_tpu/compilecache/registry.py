@@ -251,4 +251,18 @@ def serving_registry(engine, extra: Iterable = ()) -> ProgramRegistry:
                   engine.warm_chunk(k, w, execute=execute)),
             priority=0 if (k_pad, wp) == smallest else 1,
         ))
+    # fleet disaggregation handoff programs (empty unless the engine was
+    # built with handoff=True — read from the engine for the same
+    # no-drift reason as chunk_buckets)
+    for n_pad in engine.handoff_buckets():
+        reg.add(ProgramSpec(
+            name=engine.export_program_name(n_pad),
+            warm=(lambda execute, n=n_pad:
+                  engine.warm_export(n, execute=execute)),
+        ))
+        reg.add(ProgramSpec(
+            name=engine.import_program_name(n_pad),
+            warm=(lambda execute, n=n_pad:
+                  engine.warm_import(n, execute=execute)),
+        ))
     return reg

@@ -1,0 +1,62 @@
+"""Serving fleet layer: replica router, SLO-aware admission,
+disaggregated prefill/decode (round 10 tentpole — ROADMAP item 3).
+
+One ``serving.Scheduler`` + ``PagedEngine`` is one replica; millions of
+users need N. This package is the layer above the single engine:
+
+- ``router``    — ``FleetRouter``: N single-process replicas (each on
+  its own ``jax.devices()`` slice), session-affinity routing with
+  least-loaded fallback, one host loop driving every replica's ticks,
+  and the prefill→decode handoff pump;
+- ``admission`` — ``SLOGate``: admit / spill / queue / shed against the
+  live TTFT/queue-wait percentiles each scheduler already computes
+  (PR 4), plus ``recommend_replicas``, the goodput-fed autoscaler hook;
+- ``traffic``   — seeded bursty heavy-tail traces (JSONL), the
+  step-domain ``replay_trace`` driver, and ``prompt_for``'s
+  deterministic token streams.
+
+The CPU backend cannot run multi-process collectives (known jaxlib gap,
+xfail'd since PR 1), so the fleet proof is single-process multi-mesh
+plus trace-driven router simulation — exactly what ROADMAP item 3
+prescribes. ANALYSIS.md "Serving fleet" documents the routing policy,
+the SLO gate semantics, the KV handoff cost model, and the simulation's
+caveats.
+"""
+
+from pytorch_distributed_tpu.fleet.admission import (
+    ADMIT,
+    SHED,
+    SPILL,
+    Decision,
+    SLOConfig,
+    SLOGate,
+    recommend_replicas,
+)
+from pytorch_distributed_tpu.fleet.router import FleetRouter
+from pytorch_distributed_tpu.fleet.traffic import (
+    TraceRequest,
+    clamp_trace,
+    generate_trace,
+    load_trace,
+    prompt_for,
+    replay_trace,
+    save_trace,
+)
+
+__all__ = [
+    "ADMIT",
+    "SHED",
+    "SPILL",
+    "Decision",
+    "SLOConfig",
+    "SLOGate",
+    "recommend_replicas",
+    "FleetRouter",
+    "TraceRequest",
+    "clamp_trace",
+    "generate_trace",
+    "load_trace",
+    "prompt_for",
+    "replay_trace",
+    "save_trace",
+]

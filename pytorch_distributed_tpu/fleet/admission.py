@@ -1,0 +1,177 @@
+"""SLO-aware admission: route, spill, queue, or shed — plus the
+autoscaler recommendation.
+
+One replica's scheduler never rejects: it queues on OOM and lets queue
+wait grow without bound (serving/scheduler.py — the right contract for a
+single engine that cannot know whether more capacity exists). The fleet
+layer CAN know: it holds every replica's live metrics — the exact
+host-side TTFT/queue-wait percentiles and queue depths PR 4 taught
+``Scheduler.metrics()`` to compute — so admission becomes a real
+decision:
+
+- **admit** to the session's affinity replica while it has SLO headroom;
+- **spill** to the least-loaded cool replica when the affinity replica
+  is hot (queue past ``spill_queue_depth``, or its live TTFT /
+  queue-wait p95 past the configured SLO target) — the request trades
+  prefix locality for latency;
+- **queue** on the least-loaded replica when every replica is hot but
+  none is past the shed bound — backpressure, not failure;
+- **shed** (explicit reject, reason in the per-request JSONL) only when
+  EVERY replica is past ``shed_queue_depth`` — admitting one more
+  request could not possibly meet the SLO, and an honest fast reject
+  beats a token stream that arrives after the client gave up.
+
+Thresholds live in ``SLOConfig``; the defaults never shed (infinite
+SLO, generous depths) so a bare two-replica router behaves like a pure
+load balancer until the operator states a target.
+
+``recommend_replicas`` is the autoscaler hook: scale up when every
+replica is hot (the gate is about to queue/shed — more capacity is the
+only fix), scale down when the fleet is demonstrably idle (mean slot
+occupancy below ``low_utilization``, queues empty, and the goodput
+ledger shows the wall is not being eaten by compile stalls that extra
+replicas would re-pay). It RECOMMENDS — the driving loop owns replica
+lifecycles (``Scheduler.drain_graceful`` is the safe scale-down path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, NamedTuple, Optional, Sequence
+
+#: Decision.action values
+ADMIT, SPILL, SHED = "admit", "spill", "shed"
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Admission targets. Latency SLOs are wall-clock milliseconds
+    checked against the replicas' LIVE p95 series; depth bounds are
+    step-domain (deterministic under trace replay)."""
+
+    ttft_p95_ms: float = float("inf")
+    queue_wait_p95_ms: float = float("inf")
+    #: prefer another replica once the affinity replica queues this deep
+    spill_queue_depth: int = 4
+    #: reject (with reason) once EVERY replica queues this deep
+    shed_queue_depth: int = 64
+
+    def __post_init__(self):
+        if self.spill_queue_depth < 1:
+            raise ValueError("spill_queue_depth must be >= 1")
+        if self.shed_queue_depth < self.spill_queue_depth:
+            raise ValueError(
+                "shed_queue_depth must be >= spill_queue_depth "
+                f"({self.shed_queue_depth} < {self.spill_queue_depth})"
+            )
+
+
+class Decision(NamedTuple):
+    """One routing decision: ``action`` ∈ {admit, spill, shed},
+    ``replica`` the target id (-1 on shed), ``reason`` why the affinity
+    replica was left / the request was shed ('' on plain admits)."""
+
+    action: str
+    replica: int
+    reason: str
+
+
+class SLOGate:
+    """Stateless routing policy over live per-replica metrics dicts
+    (``Scheduler.metrics()`` shape: ``queue_depth``, ``occupancy``,
+    ``ttft_p95_s``, ``queue_wait_p95_s``, ``draining``)."""
+
+    def __init__(self, slo: Optional[SLOConfig] = None):
+        self.slo = slo if slo is not None else SLOConfig()
+
+    # ---- per-replica predicates ----
+
+    def hot(self, m: dict) -> Optional[str]:
+        """The first SLO signal this replica violates, or None while it
+        has headroom. Draining replicas are permanently hot — the gate
+        routes around them during scale-down."""
+        if m.get("draining"):
+            return "draining"
+        if m["queue_depth"] >= self.slo.spill_queue_depth:
+            return "queue_depth"
+        if m.get("ttft_p95_s", 0.0) * 1e3 > self.slo.ttft_p95_ms:
+            return "slo_ttft_p95"
+        if m.get("queue_wait_p95_s", 0.0) * 1e3 > self.slo.queue_wait_p95_ms:
+            return "slo_queue_wait_p95"
+        return None
+
+    def overloaded(self, m: dict) -> bool:
+        """Past the point where queueing is honest: one more request
+        cannot meet the SLO no matter how the fleet routes it."""
+        return (
+            bool(m.get("draining"))
+            or m["queue_depth"] >= self.slo.shed_queue_depth
+        )
+
+    @staticmethod
+    def _load_key(m: dict):
+        return (m["queue_depth"], m.get("occupancy", 0.0))
+
+    # ---- the routing decision ----
+
+    def route(self, metrics: Dict[int, dict],
+              preferred: Optional[int] = None) -> Decision:
+        """Pick a replica for one request given each candidate replica's
+        live metrics (``{replica_id: metrics_dict}``) and the session's
+        affinity replica (None for session-less requests)."""
+        if not metrics:
+            raise ValueError("route() needs at least one candidate replica")
+        hot = {i: self.hot(m) for i, m in metrics.items()}
+        if preferred is not None and hot.get(preferred) is None:
+            return Decision(ADMIT, preferred, "")
+        by_load = sorted(metrics, key=lambda i: self._load_key(metrics[i]))
+        cool = [i for i in by_load if hot[i] is None]
+        if cool:
+            action = SPILL if preferred is not None else ADMIT
+            return Decision(action, cool[0], hot.get(preferred) or "")
+        if all(self.overloaded(m) for m in metrics.values()):
+            victim = preferred if preferred is not None else by_load[0]
+            return Decision(SHED, -1, hot[victim] or "queue_depth")
+        # every replica hot, none past the shed bound: queue on the
+        # least-loaded that can still take work — backpressure
+        for i in by_load:
+            if not self.overloaded(metrics[i]):
+                action = (
+                    SPILL if preferred is not None and i != preferred
+                    else ADMIT
+                )
+                return Decision(action, i, hot[i] or "")
+        return Decision(SHED, -1, "queue_depth")  # unreachable guard
+
+
+def recommend_replicas(
+    n_now: int,
+    metrics: Sequence[dict],
+    gate: SLOGate,
+    *,
+    low_utilization: float = 0.25,
+) -> int:
+    """Replica-count recommendation from live fleet metrics.
+
+    Scale **up** when every replica is hot (the gate has nowhere cool
+    left to route — more capacity is the only lever). Scale **down**
+    when the fleet is provably idle: mean slot occupancy under
+    ``low_utilization``, all queues empty, and mean ledger goodput
+    (non-compile wall fraction) above 0.5 — a compile-bound fleet is
+    warming up, not idle, and shrinking it would re-pay the warmup.
+    Otherwise hold. Never recommends below 1.
+    """
+    if not metrics:
+        return n_now
+    if all(gate.hot(m) is not None for m in metrics):
+        return n_now + 1
+    occ = sum(m.get("occupancy_mean", 0.0) for m in metrics) / len(metrics)
+    goodput = sum(m.get("goodput_frac", 1.0) for m in metrics) / len(metrics)
+    if (
+        n_now > 1
+        and occ < low_utilization
+        and goodput > 0.5
+        and all(m["queue_depth"] == 0 for m in metrics)
+    ):
+        return n_now - 1
+    return n_now

@@ -1,0 +1,362 @@
+"""The replica router: N schedulers behind one front-end, one host loop.
+
+``FleetRouter`` owns ``n_replicas`` ``Scheduler`` + ``PagedEngine``
+replicas — single-process, each committed to its own device slice of
+``jax.devices()`` (round-robin; on a one-device host they share it and
+the router degrades to a pure scheduling simulation, which is exactly
+the CPU-backend proof ROADMAP item 3 prescribes — multi-process
+collectives are a known jaxlib CPU gap). Requests enter through
+``submit`` with an optional session id:
+
+- **session affinity**: a session's first request pins it to the
+  replica the SLO gate picks; later requests follow (prefix locality —
+  the seam ROADMAP item 2's radix cache plugs into), unless the gate
+  spills them off a hot replica;
+- **SLO-aware admission** (``fleet.admission.SLOGate``): admit / spill /
+  queue / shed against the live per-replica TTFT/queue-wait percentiles
+  and queue depths; sheds are explicit per-request JSONL records with
+  ``rejected: true`` and a reason;
+- **one host loop**: ``step()`` ticks every replica once — decode
+  replicas first (their token sync never waits behind freshly dispatched
+  prefill work), then prefill/mixed replicas, then the handoff pump.
+
+Disaggregated prefill/decode (``disaggregate=True``): the first
+``n_prefill`` replicas run ``prefill_only`` schedulers — chunk programs
+only, requests parked in ``ready`` when their prompt is in the pool —
+and the rest run decode. The handoff pump moves each ready request's KV
+blocks into the least-loaded decode replica
+(``PagedEngine.export_chain`` → ``import_chain``: an explicit
+``jax.device_put`` block transfer plus a block-table remap in the
+target pool), after which the request decodes exactly as if it had
+prefilled there — token-identical greedy streams, proven in
+tests/test_fleet.py. Decode token gaps stop paying for prefill bursts:
+a mixed replica's decode tick is data-dependent on the chunk program
+that precedes it in the same step (shared pool, same device), while a
+decode replica's tick depends only on its own pool.
+
+Replica geometry (config, slots, block_len, chunk) is uniform across
+the fleet — the handoff requires pool-compatible blocks, and uniform
+replicas keep the registry story simple: ``registries()`` builds one
+``compilecache.serving_registry`` per replica (per-mesh/per-device) and
+``assert_registry_covers()`` runs the coverage guard across all of
+them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.fleet.admission import (
+    ADMIT,
+    SHED,
+    SPILL,
+    SLOConfig,
+    SLOGate,
+    recommend_replicas,
+)
+from pytorch_distributed_tpu.serving.scheduler import Scheduler
+from pytorch_distributed_tpu.telemetry import LatencySeries, percentiles
+
+
+class FleetRouter:
+    """Front-end over N single-process replicas.
+
+    ``submit(prompt, max_new, session=...)`` routes (or sheds) one
+    request and returns its fleet-wide rid; ``step()`` advances every
+    replica one tick and returns ``[(rid, token)]``; ``drain()`` runs
+    the fleet to empty. ``metrics()`` aggregates fleet percentiles,
+    shed/spill rates, per-replica summaries, and the autoscaler's
+    current recommendation.
+    """
+
+    def __init__(self, config, params, n_replicas: int = 2, *,
+                 disaggregate: bool = False, n_prefill: int = 1,
+                 decode_slots: Optional[int] = None,
+                 handoffs_per_tick: Optional[int] = None,
+                 slo: Optional[SLOConfig] = None, devices=None,
+                 seed: int = 0, metrics_log=None, tracer=None,
+                 **scheduler_kwargs):
+        import jax
+
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if disaggregate:
+            if n_replicas < 2:
+                raise ValueError("disaggregation needs >= 2 replicas")
+            if not 1 <= n_prefill < n_replicas:
+                raise ValueError(
+                    f"n_prefill must be in [1, {n_replicas - 1}], "
+                    f"got {n_prefill}"
+                )
+        if devices is None:
+            devices = jax.devices()
+        self.gate = SLOGate(slo)
+        self.metrics_log = metrics_log
+        self.replicas: List[Scheduler] = []
+        self.roles: List[str] = []
+        for i in range(n_replicas):
+            role = (
+                ("prefill" if i < n_prefill else "decode")
+                if disaggregate else "mixed"
+            )
+            # one device per replica, round-robin over the host's slice
+            # of jax.devices(); on a single-device host all replicas
+            # share it (placement left implicit — bit-identical to a
+            # plain Scheduler)
+            dev = devices[i % len(devices)] if len(devices) > 1 else None
+            # disaggregation sizes roles independently (the DistServe
+            # argument): a request holds a prefill slot for
+            # ceil(prompt/chunk) ticks but a decode slot for max_new
+            # ticks, so decode replicas usually want MORE lanes — pool
+            # block geometry stays uniform (the handoff requires it),
+            # only the lane count differs
+            kw = dict(scheduler_kwargs)
+            if role == "decode" and decode_slots is not None:
+                kw["n_slots"] = decode_slots
+            self.replicas.append(Scheduler(
+                config, params, replica_id=i, seed=seed + i,
+                prefill_only=(role == "prefill"), device=dev,
+                handoff=disaggregate, metrics_log=metrics_log,
+                tracer=tracer, **kw,
+            ))
+            self.roles.append(role)
+        self.disaggregated = disaggregate
+        #: max KV handoffs per tick (None = unbounded). The handoff's
+        #: host-driven gather/put/scatter runs between decode ticks in
+        #: the one-loop simulation; budgeting it bounds how much a
+        #: prefill burst can stretch resident streams' token gaps —
+        #: trading a little TTFT for decode p95, same as a transfer-
+        #: bandwidth cap would on real interconnect
+        self.handoffs_per_tick = handoffs_per_tick
+        #: replicas requests enter through (mixed, or prefill in disagg)
+        self.entry_group = [
+            i for i, r in enumerate(self.roles) if r != "decode"
+        ]
+        self.decode_group = [
+            i for i, r in enumerate(self.roles) if r == "decode"
+        ]
+        self._next_rid = 0
+        self._affinity: Dict[int, int] = {}  # session -> replica
+        self.placement: Dict[int, int] = {}  # rid -> current replica
+        self.rejected: Dict[int, str] = {}  # rid -> shed reason
+        self.results: Dict[int, List[int]] = {}
+        self._spilled = 0
+        self._handoff_count = 0
+        self.handoff_lat = LatencySeries("handoff")
+        self._start_time: Optional[float] = None
+        self._tick = 0
+        # the autoscaler signal is only meaningful UNDER load — a
+        # drained fleet always says "hold" — so the router samples the
+        # recommendation as it runs and keeps the high-water mark
+        self._recommend_peak = len(self.entry_group)
+
+    # ---- routing ----
+
+    def _group_metrics(self, group: List[int]) -> Dict[int, dict]:
+        return {i: self.replicas[i].metrics() for i in group}
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int, *,
+               session: Optional[int] = None) -> int:
+        """Route one request; returns its fleet rid. A shed request gets
+        a rid too — ``rejected[rid]`` holds the reason and no tokens
+        will ever stream for it (the explicit fast-reject contract)."""
+        rid = self._next_rid
+        self._next_rid += 1
+        preferred = (
+            self._affinity.get(session) if session is not None else None
+        )
+        decision = self.gate.route(
+            self._group_metrics(self.entry_group), preferred
+        )
+        if decision.action == SHED:
+            self.rejected[rid] = decision.reason
+            if self.metrics_log is not None:
+                self.metrics_log.log(
+                    kind="request", rid=rid,
+                    replica_id=(preferred if preferred is not None else -1),
+                    rejected=True, reject_reason=decision.reason,
+                    session=session,
+                    prompt_len=int(np.asarray(prompt).size),
+                    new_tokens=0,
+                )
+            return rid
+        target = decision.replica
+        if session is not None and session not in self._affinity:
+            self._affinity[session] = target
+        if decision.action == SPILL:
+            self._spilled += 1
+        self.replicas[target].submit(
+            prompt, max_new_tokens, session=session,
+            spilled=(decision.action == SPILL), rid=rid,
+        )
+        self.placement[rid] = target
+        return rid
+
+    # ---- the host loop ----
+
+    def _pump_handoffs(self) -> None:
+        """Move every ready request's KV blocks prefill→decode. Targets
+        are tried least-loaded-first; a full decode fleet leaves the
+        request parked (blocks intact on the prefill replica) for the
+        next tick — the same queue-don't-crash contract as admission."""
+        budget = (
+            self.handoffs_per_tick
+            if self.handoffs_per_tick is not None else float("inf")
+        )
+        order = sorted(
+            self.decode_group,
+            key=lambda i: (len(self.replicas[i].resident),
+                           len(self.replicas[i].queue)),
+        )
+        for pi in self.entry_group:
+            ps = self.replicas[pi]
+            for rid in ps.ready_rids():
+                if budget <= 0:
+                    return
+                req, export = ps.peek_ready(rid)
+                t0 = time.perf_counter()
+                adopted_by = next(
+                    (di for di in order
+                     if self.replicas[di].adopt(req, export)), None,
+                )
+                if adopted_by is None:
+                    break  # no decode capacity this tick; retry later
+                ps.complete_handoff(rid)
+                self.handoff_lat.observe(time.perf_counter() - t0)
+                self.placement[rid] = adopted_by
+                self._handoff_count += 1
+                budget -= 1
+
+    def step(self) -> List[Tuple[int, int]]:
+        """One fleet tick: decode replicas first (their token sync stays
+        clear of this tick's fresh prefill dispatches), then
+        prefill/mixed replicas, then the handoff pump."""
+        if self._start_time is None:
+            self._start_time = time.perf_counter()
+        out: List[Tuple[int, int]] = []
+        for i in self.decode_group:
+            out.extend(self.replicas[i].step())
+        for i in self.entry_group:
+            out.extend(self.replicas[i].step())
+        if self.decode_group:
+            self._pump_handoffs()
+        for rid, tok in out:
+            self.results.setdefault(rid, []).append(tok)
+        self._tick += 1
+        if self._tick % 16 == 0:  # sampled: metrics() per tick is waste
+            self._recommend_peak = max(self._recommend_peak,
+                                       self.recommend_replicas())
+        return out
+
+    @property
+    def idle(self) -> bool:
+        return all(
+            not s.queue and not s.resident for s in self.replicas
+        )
+
+    def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """Step until every replica is empty; returns ``{rid: [tokens]}``
+        for every request that produced output (shed rids absent)."""
+        for _ in range(max_steps):
+            if self.idle:
+                return dict(self.results)
+            self.step()
+        raise RuntimeError(
+            f"fleet drain did not converge within {max_steps} steps"
+        )
+
+    # ---- compile-cache integration ----
+
+    def registries(self):
+        """One ``compilecache.serving_registry`` per replica — the
+        programs each replica can ever compile, enumerated on ITS
+        mesh/device placement."""
+        from pytorch_distributed_tpu.compilecache import serving_registry
+
+        return [
+            serving_registry(s.engine, extra=(f"replica={s.replica_id}",
+                                              f"role={role}"))
+            for s, role in zip(self.replicas, self.roles)
+        ]
+
+    def assert_registry_covers(self) -> None:
+        """Fleet-wide coverage guard: every compiled program on every
+        replica must have been predicted by that replica's registry."""
+        for reg, s in zip(self.registries(), self.replicas):
+            reg.assert_covers(s.engine.compiled_program_names())
+
+    def warmup(self, background: bool = False) -> None:
+        """Compile every replica's programs before traffic (decode
+        replicas only ever need the decode tick, but uniform warmup
+        keeps role changes free)."""
+        for s in self.replicas:
+            s.warmup(background=background)
+
+    # ---- metrics ----
+
+    def recommend_replicas(self) -> int:
+        """The autoscaler hook (``fleet.admission.recommend_replicas``)
+        over the ENTRY group's live metrics — decode replicas scale with
+        prefill replicas, not independently, in this round."""
+        return recommend_replicas(
+            len(self.entry_group),
+            list(self._group_metrics(self.entry_group).values()),
+            self.gate,
+        )
+
+    def metrics(self) -> dict:
+        """Fleet rollup: totals, shed/spill rates, fleet-wide latency
+        percentiles (replica series concatenated — every request appears
+        in exactly one replica's series), handoff stats, the autoscaler
+        recommendation, and flat per-replica key summaries."""
+        per = [s.metrics() for s in self.replicas]
+        submitted = self._next_rid
+        shed = len(self.rejected)
+        placed = submitted - shed
+        elapsed = (
+            time.perf_counter() - self._start_time
+            if self._start_time is not None else 0.0
+        )
+        out: dict = {
+            "replicas": len(self.replicas),
+            "disaggregated": self.disaggregated,
+            "submitted": submitted,
+            "shed": shed,
+            "spilled": self._spilled,
+            "shed_rate": shed / submitted if submitted else 0.0,
+            "spill_rate": self._spilled / placed if placed else 0.0,
+            "completed": sum(m["completed"] for m in per),
+            "tokens_out": sum(m["tokens_out"] for m in per),
+            "tokens_per_s": (
+                sum(m["tokens_out"] for m in per) / elapsed
+                if elapsed else 0.0
+            ),
+            "handoffs": self._handoff_count,
+            "recommended_replicas": self.recommend_replicas(),
+            "recommended_replicas_peak": self._recommend_peak,
+        }
+        out.update(self.handoff_lat.summary("handoff"))
+        for name in ("ttft", "token_lat", "queue_wait"):
+            vals: List[float] = []
+            for s in self.replicas:
+                vals.extend(getattr(s, name).values)
+            for q, v in percentiles(vals).items():
+                out[f"{name}_{q}_s"] = v
+        for i, m in enumerate(per):
+            for k in ("tokens_out", "completed", "queue_depth",
+                      "occupancy_mean", "goodput_frac"):
+                out[f"r{i}_{k}"] = m[k]
+            for k in ("ttft_p95_s", "queue_wait_p95_s"):
+                if k in m:
+                    out[f"r{i}_{k}"] = m[k]
+            out[f"r{i}_role"] = self.roles[i]
+        return out
+
+    def log_summary(self) -> None:
+        """One ``kind="fleet_summary"`` JSONL record — the fleet half of
+        what ``scripts/telemetry_report.py`` renders."""
+        if self.metrics_log is not None:
+            self.metrics_log.log(kind="fleet_summary", **self.metrics())

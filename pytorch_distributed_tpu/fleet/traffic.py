@@ -1,0 +1,228 @@
+"""Seeded traffic traces: bursty arrivals, heavy-tail lengths, replay.
+
+Every serving measurement before round 10 drove equilibrium traffic —
+all requests submitted up front, or Poisson at the closed-form
+equilibrium rate (scripts/bench_serving.py). Real deployments are the
+opposite regime: arrivals come in bursts (diurnal spikes, retry storms,
+one tenant's batch job) and prompt/output lengths are heavy-tailed (a
+p99 prompt many times the median — the shape vLLM/Orca traces show).
+The fleet layer's whole value — spill, shed, disaggregation — only
+shows under that traffic, so this module makes it a first-class,
+reusable artifact:
+
+- ``generate_trace``: a seeded arrival process — Poisson at
+  ``base_rate`` with periodic burst episodes at ``base_rate *
+  burst_rate_mult`` — with lognormal (heavy-tail) prompt and output
+  lengths, assigned round-robin-free random session ids for affinity
+  routing. Deterministic per seed.
+- ``save_trace``/``load_trace``: one-line-per-request JSONL (plus a
+  ``kind="trace_header"`` provenance line recording the generator
+  parameters), so the same trace file feeds the fleet bench, the
+  single-replica bench, ``recipes/serve_lm.py --trace``, and the CI
+  fleet smoke.
+- ``replay_trace``: the step-indexed driver. Arrival times are mapped
+  to scheduler ticks via a NOMINAL tick length (``tick_s``) — offered
+  load is then defined in the step domain (requests per tick), which is
+  machine-independent: whether one contended CPU core or a TPU pod
+  turns the crank, replica capacity per tick and the backlog a trace
+  builds are identical. Wall-clock latencies (TTFT, token gaps) are
+  still measured for real by the schedulers underneath.
+
+Prompt TOKENS are not stored in the trace (only lengths): they are
+regenerated deterministically per rid by ``prompt_for`` at replay time,
+so a trace file is model-vocab-agnostic and stays small.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceRequest:
+    """One arrival: ``t`` seconds since trace start (nominal time),
+    ``session`` the affinity key, lengths in tokens."""
+
+    rid: int
+    t: float
+    session: int
+    prompt_len: int
+    max_new: int
+
+
+def _heavy_tail(rng, median: float, sigma: float, lo: int,
+                hi: Optional[int]) -> int:
+    """Lognormal sample clipped to [lo, hi] — median ``median``, tail
+    weight ``sigma`` (sigma 0.8 puts p99 at ~6x the median)."""
+    v = rng.lognormal(mean=float(np.log(max(median, 1.0))), sigma=sigma)
+    if hi is not None:
+        v = min(v, hi)
+    return int(max(lo, round(v)))
+
+
+def generate_trace(
+    *,
+    seed: int = 0,
+    duration_s: float = 60.0,
+    base_rate: float = 2.0,
+    burst_rate_mult: float = 4.0,
+    burst_every_s: float = 10.0,
+    burst_len_s: float = 2.0,
+    sessions: int = 16,
+    prompt_median: int = 32,
+    prompt_sigma: float = 0.8,
+    prompt_min: int = 4,
+    prompt_max: Optional[int] = None,
+    max_new_median: int = 12,
+    max_new_sigma: float = 0.6,
+    max_new_min: int = 2,
+    max_new_max: Optional[int] = None,
+) -> List[TraceRequest]:
+    """Seeded bursty heavy-tail trace.
+
+    Arrivals are a piecewise Poisson process: rate ``base_rate`` req/s,
+    lifted to ``base_rate * burst_rate_mult`` inside burst episodes (the
+    first ``burst_len_s`` of every ``burst_every_s`` window). Prompt and
+    output lengths are lognormal with medians/sigmas as given. The same
+    seed always yields the same trace.
+    """
+    if duration_s <= 0 or base_rate <= 0:
+        raise ValueError("duration_s and base_rate must be positive")
+    if burst_rate_mult < 1.0:
+        raise ValueError("burst_rate_mult must be >= 1 (1 = no bursts)")
+    rng = np.random.default_rng(seed)
+    out: List[TraceRequest] = []
+    t = 0.0
+    while True:
+        in_burst = (
+            burst_len_s > 0 and (t % burst_every_s) < burst_len_s
+        )
+        rate = base_rate * (burst_rate_mult if in_burst else 1.0)
+        t += float(rng.exponential(1.0 / rate))
+        if t >= duration_s:
+            return out
+        out.append(TraceRequest(
+            rid=len(out),
+            t=t,
+            session=int(rng.integers(sessions)),
+            prompt_len=_heavy_tail(rng, prompt_median, prompt_sigma,
+                                   prompt_min, prompt_max),
+            max_new=_heavy_tail(rng, max_new_median, max_new_sigma,
+                                max_new_min, max_new_max),
+        ))
+
+
+def prompt_for(req: TraceRequest, vocab_size: int,
+               seed: int = 0) -> np.ndarray:
+    """The request's deterministic prompt tokens — a per-rid seeded
+    stream, so replays of one trace agree token-for-token across
+    processes and configurations sharing a vocab."""
+    rng = np.random.default_rng((seed, req.rid))
+    return rng.integers(1, vocab_size, size=req.prompt_len,
+                        dtype=np.int64).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# JSONL persistence
+# ---------------------------------------------------------------------------
+
+
+def save_trace(path: str, trace: List[TraceRequest], **header) -> None:
+    """Write the reusable JSONL trace: a ``trace_header`` provenance
+    line (generator params, free-form) then one ``kind="trace"`` line
+    per request."""
+    with open(path, "w") as f:
+        f.write(json.dumps(
+            {"kind": "trace_header", "requests": len(trace), **header}
+        ) + "\n")
+        for r in trace:
+            f.write(json.dumps({
+                "kind": "trace", "rid": r.rid, "t": round(r.t, 6),
+                "session": r.session, "prompt_len": r.prompt_len,
+                "max_new": r.max_new,
+            }) + "\n")
+
+
+def load_trace(path: str) -> List[TraceRequest]:
+    """Read a trace JSONL (unknown kinds skipped, so traces can live in
+    mixed telemetry streams); rids are re-checked to be unique."""
+    out: List[TraceRequest] = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i + 1}: not JSONL ({e})") from e
+            if rec.get("kind") != "trace":
+                continue
+            out.append(TraceRequest(
+                rid=int(rec["rid"]), t=float(rec["t"]),
+                session=int(rec["session"]),
+                prompt_len=int(rec["prompt_len"]),
+                max_new=int(rec["max_new"]),
+            ))
+    if len({r.rid for r in out}) != len(out):
+        raise ValueError(f"{path}: duplicate rids in trace")
+    return out
+
+
+def clamp_trace(trace: List[TraceRequest], max_seq_len: int,
+                chunk: int) -> List[TraceRequest]:
+    """Fit a trace to a serving config: clip each request so its
+    chunk-padded prompt AND prompt+output fit ``max_seq_len`` (the
+    scheduler's admission contract). Keeps arrival times and sessions —
+    the traffic shape — while making any trace servable by any config."""
+    out = []
+    for r in trace:
+        # leave at least one decode token's room below max_seq_len
+        plen = max(1, min(r.prompt_len, (max_seq_len // chunk) * chunk,
+                          max_seq_len - 1))
+        mnew = max(1, min(r.max_new, max_seq_len - plen))
+        out.append(dataclasses.replace(r, prompt_len=plen, max_new=mnew))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+
+def replay_trace(
+    trace: List[TraceRequest],
+    submit: Callable[[TraceRequest], None],
+    tick: Callable[[], None],
+    is_idle: Callable[[], bool],
+    *,
+    tick_s: float = 1.0,
+    max_steps: int = 1_000_000,
+) -> int:
+    """Drive any serving front-end through a trace in the step domain.
+
+    Tick ``k`` first submits every request with ``t <= k * tick_s``,
+    then calls ``tick()`` once; after the last arrival it keeps ticking
+    until ``is_idle()``. ``tick_s`` is the NOMINAL tick — it converts
+    trace time to tick indices and nothing else, so a trace offers the
+    same per-tick load on any machine. Returns the number of ticks run.
+    """
+    if tick_s <= 0:
+        raise ValueError("tick_s must be positive")
+    trace = sorted(trace, key=lambda r: (r.t, r.rid))
+    i = 0
+    for step in range(max_steps):
+        while i < len(trace) and trace[i].t <= step * tick_s:
+            submit(trace[i])
+            i += 1
+        if i >= len(trace) and is_idle():
+            return step
+        tick()
+    raise RuntimeError(
+        f"replay did not converge within {max_steps} ticks "
+        f"({len(trace) - i} arrivals pending)"
+    )

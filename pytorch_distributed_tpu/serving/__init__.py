@@ -31,7 +31,7 @@ from pytorch_distributed_tpu.serving.kv_pool import (
     init_paged_cache,
     paged_cache_specs,
 )
-from pytorch_distributed_tpu.serving.engine import PagedEngine
+from pytorch_distributed_tpu.serving.engine import KVExport, PagedEngine
 from pytorch_distributed_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = [
@@ -40,6 +40,7 @@ __all__ = [
     "blocks_needed",
     "init_paged_cache",
     "paged_cache_specs",
+    "KVExport",
     "PagedEngine",
     "Request",
     "Scheduler",

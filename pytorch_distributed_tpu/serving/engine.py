@@ -62,6 +62,27 @@ class ChunkJob(NamedTuple):
     last_idx: int
 
 
+class KVExport(NamedTuple):
+    """One request's KV detached from its source pool — the unit of the
+    fleet layer's prefill→decode handoff (``fleet/``; ANALYSIS.md
+    "Serving fleet").
+
+    ``blocks`` is the pool pytree sliced to the request's chain (each
+    leaf ``[n_blocks, block_len, H_kv, D]``, logical positions in chain
+    order) and ``logits_row`` the final-chunk logits — the distribution
+    of the request's first decoded token, which the importing engine's
+    decode tick samples from. Block ids do NOT travel: the importer
+    allocates a fresh chain in its own pool and remaps the block table,
+    so exporter and importer pools never need to agree on layout — only
+    on geometry (``block_len`` and the cache tree structure, both checked
+    on import)."""
+
+    blocks: object  # pool pytree sliced to the chain: [n, block_len, ...]
+    logits_row: object  # [vocab_size] f32
+    n_blocks: int
+    block_len: int
+
+
 class PagedEngine:
     """Device state + compiled programs for paged continuous batching.
 
@@ -86,7 +107,8 @@ class PagedEngine:
     def __init__(self, config, params, n_slots: int, *,
                  n_blocks: Optional[int] = None, block_len: int = 16,
                  prefill_chunk: int = 128, temperature: float = 0.0,
-                 top_k: Optional[int] = None, mesh=None):
+                 top_k: Optional[int] = None, mesh=None, device=None,
+                 handoff: bool = False):
         from pytorch_distributed_tpu.models.generate import (
             _validate_sampling,
             _validate_serving_config,
@@ -96,6 +118,11 @@ class PagedEngine:
         _validate_sampling(config, temperature, top_k)
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if mesh is not None and device is not None:
+            raise ValueError(
+                "pass mesh= (TP sub-mesh) or device= (single-device "
+                "replica placement), not both"
+            )
         self.config = config
         self.n_slots = n_slots
         self.block_len = block_len
@@ -122,6 +149,13 @@ class PagedEngine:
 
         self._chunk_fns: Dict[Tuple[int, int], callable] = {}
         self._decode_fn = None
+        # prefill→decode handoff programs (fleet disaggregation), one
+        # per pow2 chain-length bucket. Gated by ``handoff=`` so engines
+        # that never hand off predict no kv_export/kv_import programs
+        # (the registry coverage guard would flag them as rogue).
+        self.handoff = handoff
+        self._export_fns: Dict[int, callable] = {}
+        self._import_fns: Dict[int, callable] = {}
         # buckets whose program has EXECUTED at least once (call path hot:
         # the next call pays zero compile/load) — run_chunks/decode and the
         # execute-mode warmups add to these; AOT-only warmup does not (the
@@ -154,6 +188,18 @@ class PagedEngine:
         else:
             self.mesh = None
             self.params = params
+        # Fleet replica placement (fleet/router.py): commit this engine's
+        # whole working set — params, pool, logits — to one device carved
+        # out of jax.devices(), so N single-process replicas each dispatch
+        # onto their own sub-mesh and their programs can overlap. The
+        # compiled programs follow their committed inputs; host-built
+        # operands (tokens, tables) stay uncommitted and are free to land
+        # wherever the committed arguments already live.
+        self.device = device
+        if device is not None:
+            self.params = jax.device_put(self.params, device)
+            self.cache = jax.device_put(self.cache, device)
+            self.logits = jax.device_put(self.logits, device)
 
     # ---- program builders (cached per static shape) ----
 
@@ -285,6 +331,65 @@ class PagedEngine:
         ws.append(self.table_width)
         return [(k, w) for k in ks for w in sorted(set(ws))]
 
+    @staticmethod
+    def export_program_name(n_pad: int) -> str:
+        return f"kv_export[n={n_pad}]"
+
+    @staticmethod
+    def import_program_name(n_pad: int) -> str:
+        return f"kv_import[n={n_pad}]"
+
+    def handoff_buckets(self) -> List[int]:
+        """Every chain-length bucket the handoff programs can compile
+        for — pow2 lengths clipped to ``table_width``, the exact range
+        ``_chain_bucket`` can produce (admission bounds every chain by
+        the table width). Empty unless the engine was built with
+        ``handoff=True``, so non-fleet registries predict no handoff
+        programs."""
+        if not self.handoff:
+            return []
+        ns, n = [], 1
+        while n < self.table_width:
+            ns.append(n)
+            n <<= 1
+        ns.append(self.table_width)
+        return sorted(set(ns))
+
+    def warm_export(self, n_pad: int, execute: bool = True) -> None:
+        """Compile (and inertly run) one export bucket: reading the
+        trash block and slot 0's logits row mutates nothing."""
+        fn = self._export_fn(n_pad)
+        idx = jnp.full((n_pad,), TRASH_BLOCK, jnp.int32)
+        slot = jnp.asarray(0, jnp.int32)
+        if execute:
+            fn(self.cache, self.logits, idx, slot)
+        else:
+            cache_aval, logits_aval = self._cache_logits_avals()
+            fn.lower(cache_aval, logits_aval, idx, slot).compile()
+
+    def warm_import(self, n_pad: int, execute: bool = True) -> None:
+        """Compile (and inertly run) one import bucket: every lane
+        scatters into the trash block and the logits row targets the
+        out-of-bounds ``n_slots`` sentinel (dropped), so live state is
+        untouched."""
+        fn = self._import_fn(n_pad)
+        blocks = jax.tree.map(
+            lambda pool: jnp.zeros((n_pad,) + pool.shape[1:], pool.dtype),
+            self.cache,
+        )
+        idx = jnp.full((n_pad,), TRASH_BLOCK, jnp.int32)
+        slot = jnp.asarray(self.n_slots, jnp.int32)
+        row = jnp.zeros((self.config.vocab_size,), self.logits.dtype)
+        if execute:
+            self.cache, self.logits = fn(
+                self.cache, self.logits, blocks, idx, slot, row,
+            )
+        else:
+            cache_aval, logits_aval = self._cache_logits_avals()
+            fn.lower(
+                cache_aval, logits_aval, blocks, idx, slot, row
+            ).compile()
+
     def has_chunk_program(self, k_pad: int, wp: int) -> bool:
         """True when the bucket's call path is hot (executed before)."""
         return (k_pad, wp) in self._hot_chunks
@@ -299,6 +404,10 @@ class PagedEngine:
                  sorted(self._chunk_fns)]
         if self._decode_fn is not None:
             names.append(self.DECODE_PROGRAM)
+        names += [self.export_program_name(n) for n in
+                  sorted(self._export_fns)]
+        names += [self.import_program_name(n) for n in
+                  sorted(self._import_fns)]
         return names
 
     def _cache_logits_avals(self):
@@ -360,6 +469,8 @@ class PagedEngine:
         tables = jnp.full((self.n_slots, self.table_width), TRASH_BLOCK,
                           jnp.int32)
         rng = jax.random.key(0)
+        if self.device is not None:
+            rng = jax.device_put(rng, self.device)
         if execute:
             self.cache, self.logits, _, _ = fn(
                 self.params, self.cache, self.logits, positions, active,
@@ -404,6 +515,126 @@ class PagedEngine:
         (now inactive) lane can never touch recycled blocks."""
         self.allocator.free(slot)
         self.tables[slot] = TRASH_BLOCK
+
+    def release_all(self) -> None:
+        """Free every live chain and reset all tables — the scale-down
+        teardown after a graceful drain (fleet/; by then ``in_use`` is
+        already 0, so this is a belt-and-braces reset, not a leak
+        plug)."""
+        for owner in self.allocator.owners():
+            self.allocator.free(owner)
+        self.tables[:] = TRASH_BLOCK
+
+    # ---- prefill→decode handoff (fleet/ disaggregation) ----
+
+    def _chain_bucket(self, n: int) -> int:
+        """Pow2 chain-length bucket (clipped to ``table_width``) shared
+        by export and import so one compiled program pair serves every
+        chain of similar length; padding lanes read/write the trash
+        block."""
+        return min(_pow2_bucket(n), self.table_width)
+
+    def _require_handoff(self):
+        if not self.handoff:
+            raise RuntimeError(
+                "this engine was built without handoff=True — its "
+                "registry does not predict kv_export/kv_import programs "
+                "(fleet routers enable it on every replica they own)"
+            )
+
+    def _export_fn(self, n_pad: int):
+        fn = self._export_fns.get(n_pad)
+        if fn is not None:
+            return fn
+
+        def body(cache, logits, idx, slot):
+            blocks = jax.tree.map(lambda pool: pool[idx], cache)
+            return blocks, logits[slot]
+
+        fn = jax.jit(body)  # pure read: nothing donated
+        self._export_fns[n_pad] = fn
+        return fn
+
+    def _import_fn(self, n_pad: int):
+        fn = self._import_fns.get(n_pad)
+        if fn is not None:
+            return fn
+
+        def body(cache, logits, blocks, idx, slot, row):
+            cache = jax.tree.map(
+                lambda pool, b: pool.at[idx].set(b), cache, blocks
+            )
+            # out-of-bounds slot (warmup's n_slots sentinel) drops the
+            # scatter — same inert trick as the chunk program's padding
+            return cache, logits.at[slot].set(row)
+
+        fn = jax.jit(body, donate_argnums=(0, 1))
+        self._import_fns[n_pad] = fn
+        return fn
+
+    def export_chain(self, slot: int) -> KVExport:
+        """Detach ``slot``'s KV for transfer into another engine's pool.
+
+        ONE compiled gather per chain-length bucket pulls the chain's
+        blocks from every pool leaf plus the slot's logits row (the
+        first decode token's distribution, written by the final prefill
+        chunk); padding lanes read the trash block. Pure read — the slot
+        stays resident until ``release``; the caller sequences export →
+        ``import_chain`` on the target → release, so a failed import
+        (target pool OOM) leaves the source intact and retryable."""
+        self._require_handoff()
+        chain = self.allocator.chain(slot)
+        if not chain:
+            raise ValueError(f"slot {slot} holds no block chain to export")
+        n_pad = self._chain_bucket(len(chain))
+        idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
+        idx[:len(chain)] = chain
+        blocks, row = self._export_fn(n_pad)(
+            self.cache, self.logits, jnp.asarray(idx),
+            jnp.asarray(slot, jnp.int32),
+        )
+        return KVExport(
+            blocks=blocks,
+            logits_row=row,
+            n_blocks=len(chain),
+            block_len=self.block_len,
+        )
+
+    def import_chain(self, slot: int, export: KVExport) -> bool:
+        """Adopt an exported chain into ``slot``: allocate a fresh chain,
+        ``jax.device_put`` the blocks across meshes/devices onto this
+        pool's placement (the only cross-replica data motion in the
+        handoff), scatter them in with ONE compiled donated program, and
+        remap the block table. Returns False (state unchanged) when the
+        pool cannot supply the chain — the caller keeps the export and
+        retries, exactly the deterministic-OOM contract of ``admit``."""
+        self._require_handoff()
+        if export.block_len != self.block_len:
+            raise ValueError(
+                f"cannot import block_len={export.block_len} blocks into "
+                f"a block_len={self.block_len} pool"
+            )
+        chain = self.allocator.alloc(slot, export.n_blocks)
+        if chain is None:
+            return False
+        n_pad = self._chain_bucket(export.n_blocks)
+        idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
+        idx[:export.n_blocks] = chain
+        # the explicit block-transfer step (a no-op view when source and
+        # target share a device). Padding lanes scatter into the trash
+        # block, which absorbs anything.
+        blocks = jax.tree.map(
+            lambda b, pool: jax.device_put(b, pool.sharding),
+            export.blocks, self.cache,
+        )
+        row = jax.device_put(export.logits_row, self.logits.sharding)
+        self.cache, self.logits = self._import_fn(n_pad)(
+            self.cache, self.logits, blocks, jnp.asarray(idx),
+            jnp.asarray(slot, jnp.int32), row,
+        )
+        self.tables[slot] = TRASH_BLOCK
+        self.tables[slot, :export.n_blocks] = chain
+        return True
 
     def run_chunks(self, jobs: List[ChunkJob]) -> None:
         """ONE compiled program prefilling one chunk for each job.
@@ -452,6 +683,10 @@ class PagedEngine:
         dead garbage routed to the trash block."""
         masked = np.where(active[:, None], self.tables, TRASH_BLOCK)
         fn = self._decode()
+        if self.device is not None:
+            # keys are computed arrays; pin them next to the replica's
+            # committed working set so the program has one placement
+            rng = jax.device_put(rng, self.device)
         self.cache, self.logits, positions, tokens = fn(
             self.params, self.cache, self.logits,
             jnp.asarray(positions, jnp.int32), jnp.asarray(active),

@@ -78,6 +78,10 @@ class BlockAllocator:
     def chain(self, owner: int) -> List[int]:
         return list(self._chains.get(owner, ()))
 
+    def owners(self) -> List[int]:
+        """Owners currently holding a chain (drain accounting / teardown)."""
+        return list(self._chains)
+
     def alloc(self, owner: int, n: int) -> Optional[List[int]]:
         """Allocate ``n`` blocks for ``owner`` (a slot id). Returns the
         chain, or ``None`` (state unchanged) when fewer than ``n`` blocks

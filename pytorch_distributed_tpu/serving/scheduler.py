@@ -33,6 +33,18 @@ Pass ``metrics_log`` (a ``MetricsLogger``) to stream one ``kind=
 ``scripts/telemetry_report.py`` computes percentiles from — and
 ``tracer`` (a ``telemetry.SpanTracer``) for admission / prefill_chunk /
 decode_tick spans.
+
+Fleet integration (round 10; ``fleet/``, ANALYSIS.md "Serving fleet"):
+one Scheduler is one *replica*. ``replica_id`` stamps every JSONL
+record; ``device`` commits the replica's engine to its own sub-mesh
+slice of ``jax.devices()``; ``begin_drain``/``drain_graceful`` stop
+admission, finish in-flight requests, and hand the untouched queue back
+for re-routing (zero leaked pool blocks — the scale-down primitive);
+``prefill_only`` replicas park prefill-complete requests in ``ready``
+instead of arming decode, and ``peek_ready``/``complete_handoff`` +
+``adopt`` move a request's KV blocks into a decode replica's pool
+(``PagedEngine.export_chain``/``import_chain``) — the disaggregated
+prefill/decode split.
 """
 
 from __future__ import annotations
@@ -66,6 +78,13 @@ class Request:
     admit_step: int = -1
     admit_time: float = float("nan")
     first_token_time: float = float("nan")
+    # step-domain TTFT anchor: the scheduler tick that materialized the
+    # first token. Wall latencies measure THIS machine; tick latencies
+    # measure the schedule — the fleet benches evaluate SLOs in ticks so
+    # the router A/B is invariant to how fast the simulating host turns
+    # the crank (fleet replicas tick in lockstep, so cross-replica step
+    # differences are well-defined even across a prefill→decode handoff)
+    first_token_step: int = -1
     last_token_time: float = float("nan")
     # inter-token gaps AFTER the first token (the decode-tick latency
     # this request's stream observed; the first token's latency is TTFT)
@@ -77,6 +96,11 @@ class Request:
     # the flag so percentiles can be reported warm-only vs all (and the
     # warmup runtime exists to make every request warm).
     cold: bool = False
+    # fleet routing provenance (fleet/router.py): the session the router
+    # used for affinity, and whether this request was spilled off its
+    # affinity replica by the SLO gate — both land in the JSONL record
+    session: Optional[int] = None
+    spilled: bool = False
 
     @property
     def length(self) -> int:
@@ -96,7 +120,9 @@ class Scheduler:
                  prefill_chunk: int = 64, admit_per_step: int = 4,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  seed: int = 0, eos_id: Optional[int] = None, mesh=None,
-                 tracer=None, metrics_log=None):
+                 tracer=None, metrics_log=None, replica_id: int = 0,
+                 prefill_only: bool = False, device=None,
+                 handoff: bool = False):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
 
         if eos_id is not None and not 0 <= eos_id < config.vocab_size:
@@ -110,12 +136,25 @@ class Scheduler:
         self.engine = PagedEngine(
             config, params, n_slots, n_blocks=n_blocks, block_len=block_len,
             prefill_chunk=prefill_chunk, temperature=temperature,
-            top_k=top_k, mesh=mesh,
+            top_k=top_k, mesh=mesh, device=device,
+            handoff=(handoff or prefill_only),
         )
         self.config = config
         self.n_slots = n_slots
         self.admit_per_step = admit_per_step
         self.eos_id = eos_id
+        self.replica_id = replica_id
+        self.prefill_only = prefill_only
+        self.draining = False
+        # prefill_only: requests whose prefill finished and are waiting
+        # for the fleet router to hand their KV blocks to a decode
+        # replica (rid -> the slot HERE holding them; slot + blocks stay
+        # held until complete_handoff. The slot is recorded on this side
+        # because adoption re-points req.slot at the decode replica's
+        # slot — trusting it afterwards would free someone else's slot)
+        self.ready: Dict[int, int] = {}
+        self._handoffs = 0
+        self._adopted = 0
         self._rng = jax.random.key(seed)
         self._next_rid = 0
         self._step_count = 0
@@ -140,6 +179,14 @@ class Scheduler:
         self.ttft_warm = LatencySeries("ttft_warm")
         self.token_lat = LatencySeries("token_lat")
         self.queue_wait = LatencySeries("queue_wait")
+        # wall cost of THIS replica's own step() on ticks that delivered
+        # tokens — the replica-attributed token latency. In the fleet's
+        # one-loop simulation the gap between two tokens includes every
+        # OTHER replica's step too; this series is what the stream pays
+        # on ITS replica (chunk-program interference included for mixed
+        # replicas, excluded for pure-decode ones) — the disaggregation
+        # A/B's honest metric (ANALYSIS.md "Serving fleet").
+        self.tick_lat = LatencySeries("tick")
         self._cold_requests = 0
         # wall-time ledger: serving attributes its compile stalls (lazy
         # first-bucket compiles AND warmup compile time) so cold-vs-warm
@@ -181,9 +228,22 @@ class Scheduler:
         )
         return runner.run(background=background)
 
-    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
+    def submit(self, prompt: np.ndarray, max_new_tokens: int, *,
+               session: Optional[int] = None, spilled: bool = False,
+               rid: Optional[int] = None) -> int:
         """Enqueue one request; returns its request id. Never raises for
-        capacity — only for requests no configuration could serve."""
+        capacity — only for requests no configuration could serve, and
+        for submission into a draining replica (the router must not
+        route here once ``begin_drain`` ran).
+
+        ``session``/``spilled`` are fleet routing provenance stamped into
+        the per-request JSONL; ``rid`` lets the fleet router allocate
+        request ids from ONE fleet-wide space so a request keeps its id
+        across replicas and the prefill→decode handoff."""
+        if self.draining:
+            raise RuntimeError(
+                f"replica {self.replica_id} is draining; route elsewhere"
+            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         l = len(prompt)
         if l < 1:
@@ -200,11 +260,15 @@ class Scheduler:
                 f"prompt ({l}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_seq_len {self.config.max_seq_len}"
             )
-        rid = self._next_rid
-        self._next_rid += 1
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        else:
+            self._next_rid = max(self._next_rid, rid + 1)
         self.queue.append(Request(
             rid=rid, tokens=prompt, max_new_tokens=max_new_tokens,
             submit_step=self._step_count, submit_time=time.perf_counter(),
+            session=session, spilled=spilled,
         ))
         return rid
 
@@ -215,6 +279,8 @@ class Scheduler:
         """Admit up to ``admit_per_step`` queue-head requests that can be
         served now. Strict FIFO: the first request that cannot get a slot
         or a chain stops admission for this step."""
+        if self.draining:
+            return
         free = self._free_slots()
         admitted = 0
         now = time.perf_counter()
@@ -262,6 +328,7 @@ class Scheduler:
         retirements. Returns ``[(rid, token)]``."""
         if self._start_time is None:
             self._start_time = time.perf_counter()
+        t_step0 = time.perf_counter()
         with self.tracer.span("admission", queued=len(self.queue)):
             self._admit()
         jobs = self._chunk_jobs()
@@ -285,9 +352,14 @@ class Scheduler:
                 req.prefill_done += self.engine.chunk
                 if req.prefill_done >= req.length:
                     # prefill complete: arm the decode lane at the
-                    # prompt's true frontier
+                    # prompt's true frontier — or, on a prefill-only
+                    # replica, park the request (blocks + slot held) in
+                    # ``ready`` for the router's decode handoff
                     self.positions[j.slot] = req.length
-                    self.remaining[j.slot] = req.max_new_tokens
+                    if self.prefill_only:
+                        self.ready[req.rid] = j.slot
+                    else:
+                        self.remaining[j.slot] = req.max_new_tokens
         active = self.remaining > 0
         self._occupancy_sum += len(self.resident) / self.n_slots
         self._step_count += 1
@@ -316,6 +388,7 @@ class Scheduler:
             out.append((req.rid, token))
             if req.produced == 0:
                 req.first_token_time = now
+                req.first_token_step = self._step_count
                 self.ttft.observe(now - req.submit_time)
                 if not req.cold:
                     self.ttft_warm.observe(now - req.submit_time)
@@ -337,6 +410,8 @@ class Scheduler:
                 self._log_request(req)
             else:
                 self.remaining[slot] -= 1
+        if out:
+            self.tick_lat.observe(now - t_step0)
         return out
 
     def _log_request(self, req: Request) -> None:
@@ -347,11 +422,17 @@ class Scheduler:
         self.metrics_log.log(
             kind="request",
             rid=req.rid,
+            replica_id=self.replica_id,
+            rejected=False,
+            session=req.session,
+            spilled=req.spilled,
             prompt_len=req.length,
             new_tokens=req.produced,
             cold=req.cold,
             queue_wait_s=round(req.admit_time - req.submit_time, 6),
             ttft_s=round(req.first_token_time - req.submit_time, 6),
+            queue_wait_steps=req.admit_step - req.submit_step,
+            ttft_steps=req.first_token_step - req.submit_step,
             token_gaps_s=[round(g, 6) for g in req.token_gaps],
         )
 
@@ -368,6 +449,105 @@ class Scheduler:
             f"drain did not converge within {max_steps} steps "
             f"(queue={len(self.queue)}, resident={len(self.resident)})"
         )
+
+    # ---- graceful drain (fleet scale-down / replica removal) ----
+
+    def begin_drain(self) -> None:
+        """Stop admitting: ``submit`` raises, ``step`` skips admission.
+        In-flight requests keep decoding to completion; the queue is
+        frozen for ``drain_graceful`` to hand back to the router."""
+        self.draining = True
+
+    def drain_graceful(
+        self, max_steps: int = 100_000
+    ) -> Tuple[Dict[int, List[int]], List[Request]]:
+        """Drain for scale-down: stop admitting, run every in-flight
+        request to retirement, and return ``(produced, requeued)`` —
+        the tokens the in-flight requests streamed, plus the queued
+        (never-admitted) requests the router must re-route. After this
+        returns, every pool block is back on the free list
+        (``engine.allocator.in_use == 0``): retirement freed the
+        in-flight chains and queued requests never held any.
+
+        On a ``prefill_only`` replica the in-flight requests end parked
+        in ``ready`` (their blocks intentionally held for handoff) — the
+        router completes the handoffs, after which the pool is empty
+        too."""
+        self.begin_drain()
+        requeued = list(self.queue)
+        self.queue.clear()
+        produced: Dict[int, List[int]] = {}
+        for _ in range(max_steps):
+            if not self.resident or (
+                self.prefill_only
+                and all(r.rid in self.ready
+                        for r in self.resident.values())
+            ):
+                return produced, requeued
+            for rid, tok in self.step():
+                produced.setdefault(rid, []).append(tok)
+        raise RuntimeError(
+            f"drain_graceful did not converge within {max_steps} steps "
+            f"(resident={len(self.resident)})"
+        )
+
+    # ---- prefill→decode handoff (fleet disaggregation) ----
+
+    def ready_rids(self) -> List[int]:
+        """Prefill-complete requests awaiting handoff, in rid order."""
+        return sorted(self.ready)
+
+    def peek_ready(self, rid: int):
+        """``(request, KVExport)`` for a ready request, WITHOUT releasing
+        it — the router calls ``adopt`` on the decode replica first and
+        only then ``complete_handoff``, so a full decode pool leaves the
+        request parked here, intact, for the next tick."""
+        slot = self.ready[rid]
+        return self.resident[slot], self.engine.export_chain(slot)
+
+    def complete_handoff(self, rid: int) -> None:
+        """The decode replica adopted the blocks: free this replica's
+        copy (slot + chain) and account the handoff."""
+        slot = self.ready.pop(rid)
+        del self.resident[slot]
+        self.engine.release(slot)
+        self.remaining[slot] = 0
+        self._handoffs += 1
+
+    def adopt(self, req: Request, export) -> bool:
+        """Adopt a prefill-complete request whose KV was exported from a
+        prefill replica: allocate a slot + chain, import the blocks
+        (``PagedEngine.import_chain`` — the cross-mesh ``device_put``),
+        and arm the decode lane at the prompt frontier. Returns False
+        (nothing changed, export still valid) when no slot or chain is
+        available — the router retries next tick.
+
+        The request keeps its fleet rid, submit timestamps, and
+        admission timestamps from the prefill replica, so TTFT measured
+        here is end-to-end (submit → queue → prefill → handoff → first
+        decoded token)."""
+        if self.prefill_only:
+            raise RuntimeError("a prefill_only replica cannot adopt")
+        if self.draining:
+            return False
+        free = self._free_slots()
+        if not free:
+            return False
+        slot = free[0]
+        if not self.engine.import_chain(slot, export):
+            return False
+        req.slot = slot
+        req.prefill_done = req.length
+        if req.admit_step < 0:  # adopted without a prior admission
+            req.admit_step = self._step_count
+            req.admit_time = time.perf_counter()
+            self.queue_wait.observe(req.admit_time - req.submit_time)
+        self.resident[slot] = req
+        self.positions[slot] = req.length
+        self.remaining[slot] = req.max_new_tokens
+        self._admitted += 1
+        self._adopted += 1
+        return True
 
     # ---- metrics ----
 
@@ -386,6 +566,15 @@ class Scheduler:
             if self._start_time is not None else 0.0
         )
         return {
+            "replica_id": self.replica_id,
+            "draining": self.draining,
+            "handoffs": self._handoffs,
+            "adopted": self._adopted,
+            "ready": len(self.ready),
+            # the ledger's utilization view: share of this replica's wall
+            # NOT lost to classified overheads (compile) — the
+            # fleet autoscaler folds it in next to occupancy_mean
+            "goodput_frac": self.goodput.report()["goodput_frac"],
             "steps": self._step_count,
             "queue_depth": len(self.queue),
             "occupancy": len(self.resident) / self.n_slots,
@@ -422,4 +611,5 @@ class Scheduler:
             **self.ttft_warm.summary("ttft_warm"),
             **self.token_lat.summary("token_lat"),
             **self.queue_wait.summary("queue_wait"),
+            **self.tick_lat.summary("tick"),
         }

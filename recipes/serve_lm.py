@@ -31,6 +31,20 @@ re-partitioned from the serving rule table at ``--tp N``'s degree
 
     python recipes/serve_lm.py --tiny --restore out_lm/latest.ckpt --tp 2
 
+Fleet (round 10; ANALYSIS.md "Serving fleet"): ``--replicas N`` serves
+through ``fleet.FleetRouter`` — N single-process replica engines with
+session-affinity routing and the SLO admission gate (``--slo-ttft-ms``
+sets the TTFT target it spills/sheds against); ``--disaggregate`` splits
+the replicas into prefill-only and decode roles with KV-block handoff
+(``--prefill-replicas`` sizes the split); ``--trace T.jsonl`` replays a
+seeded bursty heavy-tail traffic trace (``scripts/bench_serving.py
+--gen-trace``) instead of the all-at-once synthetic workload:
+
+    python scripts/bench_serving.py --gen-trace /tmp/t.jsonl --trace-duration 30
+    python recipes/serve_lm.py --tiny --replicas 2 --trace /tmp/t.jsonl \
+        --slo-ttft-ms 500 --metrics-out fleet.jsonl
+    python recipes/serve_lm.py --tiny --replicas 2 --disaggregate
+
 Cold start (round 8; ANALYSIS.md "Cold start & compile cache"):
 ``--warmup`` compiles every registry program (decode tick + all prefill
 buckets) before admitting traffic, and ``--compile-cache-dir`` points
@@ -120,6 +134,27 @@ def _parse() -> argparse.Namespace:
                         "devices; params are placed per the serving TP "
                         "rules at THIS degree, whatever degree wrote the "
                         "checkpoint)")
+    # Fleet (fleet/; ANALYSIS.md "Serving fleet")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through a FleetRouter with this many "
+                        "replicas (session-affinity routing + SLO "
+                        "admission gate); 1 without --trace/--disaggregate "
+                        "keeps the single-scheduler path")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="split replicas into prefill-only and decode "
+                        "roles with KV-block handoff (needs --replicas "
+                        ">= 2)")
+    p.add_argument("--prefill-replicas", type=int, default=1,
+                   help="prefill replicas when --disaggregate")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT p95 target for the admission gate: a "
+                        "replica whose live p95 exceeds it is spilled "
+                        "around; every replica past the shed queue "
+                        "depth => explicit reject")
+    p.add_argument("--trace", default=None, metavar="JSONL",
+                   help="replay a traffic trace (bench_serving.py "
+                        "--gen-trace) instead of submitting the "
+                        "synthetic workload all at once")
     return p.parse_args()
 
 
@@ -178,6 +213,64 @@ def main() -> None:
     tracer = SpanTracer() if args.trace_dir else NULL_TRACER
     mlog = MetricsLogger(args.metrics_out)
     t0 = time.perf_counter()
+    fleet_mode = args.replicas > 1 or args.disaggregate or args.trace
+    if fleet_mode and args.dense:
+        raise SystemExit("--replicas/--disaggregate/--trace need the "
+                         "paged layout; drop --dense")
+    if fleet_mode and args.tp > 1:
+        raise SystemExit("fleet replicas are single-device in this "
+                         "round; drop --tp or --replicas")
+    if fleet_mode:
+        from pytorch_distributed_tpu.fleet import (
+            FleetRouter,
+            SLOConfig,
+            clamp_trace,
+            load_trace,
+            prompt_for,
+            replay_trace,
+        )
+
+        slo = (
+            SLOConfig(ttft_p95_ms=args.slo_ttft_ms)
+            if args.slo_ttft_ms is not None else SLOConfig()
+        )
+        router = FleetRouter(
+            cfg, params, n_replicas=max(args.replicas, 2)
+            if args.disaggregate else args.replicas,
+            disaggregate=args.disaggregate,
+            n_prefill=args.prefill_replicas, slo=slo, seed=args.seed,
+            metrics_log=mlog, tracer=tracer, n_slots=args.slots,
+            block_len=args.block_len, prefill_chunk=args.prefill_chunk,
+            admit_per_step=args.admit_per_step,
+        )
+        if args.warmup:
+            router.warmup()
+        if args.trace:
+            trace = clamp_trace(
+                load_trace(args.trace), cfg.max_seq_len,
+                args.prefill_chunk,
+            )
+            replay_trace(
+                trace,
+                lambda r: router.submit(prompt_for(r, cfg.vocab_size),
+                                        r.max_new, session=r.session),
+                router.step,
+                lambda: router.idle,
+            )
+        else:
+            for i, p in enumerate(prompts):
+                router.submit(p, args.max_new, session=i % 8)
+            router.drain()
+        metrics = {"layout": "fleet", **router.metrics()}
+        router.log_summary()
+        metrics["wall_s"] = round(time.perf_counter() - t0, 2)
+        mlog.close()
+        if args.trace_dir:
+            import os
+
+            tracer.save(os.path.join(args.trace_dir, "spans.trace.json"))
+        rank0_print(json.dumps(metrics, indent=2))
+        return
     if args.dense:
         if args.warmup:
             raise SystemExit("--warmup needs the paged layout (the dense "

@@ -6,7 +6,8 @@
 # hazards fail the build while the reviewed pre-existing ones don't.
 #
 # Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke|--serving-smoke|
-#                             --telemetry-smoke|--warmup-smoke|--reshard-smoke]
+#                             --telemetry-smoke|--warmup-smoke|--reshard-smoke|
+#                             --fleet-smoke]
 #
 # --resilience-smoke: lint, then ONE crash-recovery cycle from the
 # kill-matrix (SIGKILL mid-shard-write → relaunch → assert resume) —
@@ -30,6 +31,14 @@
 # finish the run) — the cheap end-to-end proof that a preempted run can
 # resume on whatever topology the scheduler hands back, without the
 # full cross-topology kill matrix.
+#
+# --fleet-smoke: lint, then the round-10 fleet cycle on one short seeded
+# bursty trace: a 2-replica router (session affinity + SLO gate) and a
+# disaggregated prefill/decode pair (KV-block handoff) both serve the
+# trace through recipes/serve_lm.py, and telemetry_report.py must print
+# the fleet section (per-replica percentiles, shed/spill rates) from
+# their JSONLs — the cheap end-to-end proof the fleet layer still
+# routes, hands off, and reports (~15 s).
 #
 # --warmup-smoke: lint, then the compile-cache round trip: prewarm a tiny
 # LM serving registry into a fresh cache (scripts/warmup.py), re-run the
@@ -70,6 +79,24 @@ if [[ "${1:-}" == "--serving-smoke" ]]; then
     JAX_PLATFORMS=cpu python -m pytest \
         tests/test_paged_serving.py::test_serving_smoke -q \
         -p no:cacheprovider -p no:xdist -p no:randomly
+    exit 0
+fi
+
+if [[ "${1:-}" == "--fleet-smoke" ]]; then
+    echo "== fleet smoke (trace -> 2-replica router + disagg P/D -> report) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py \
+        --gen-trace "$smoke/trace.jsonl" --trace-duration 30 \
+        --trace-base-rate 0.5 --trace-prompt-max 88
+    JAX_PLATFORMS=cpu python recipes/serve_lm.py --tiny --replicas 2 \
+        --slots 4 --max-new 8 --trace "$smoke/trace.jsonl" \
+        --slo-ttft-ms 5000 --metrics-out "$smoke/fleet.jsonl"
+    JAX_PLATFORMS=cpu python recipes/serve_lm.py --tiny --replicas 2 \
+        --disaggregate --slots 4 --max-new 8 \
+        --trace "$smoke/trace.jsonl" --metrics-out "$smoke/disagg.jsonl"
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/fleet.jsonl" "$smoke/disagg.jsonl" --json --require fleet
     exit 0
 fi
 

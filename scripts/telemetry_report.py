@@ -12,7 +12,13 @@ produces, from the JSONL alone:
   (and queue wait) recomputed exactly from the per-request
   ``kind="request"`` records (falling back to the
   ``kind="serving_summary"`` percentiles when only the summary was
-  kept).
+  kept);
+- the **fleet section** (round 10; ``fleet/``) — per-replica
+  TTFT/queue-wait p50/p95/p99, shed rate (explicit rejects with
+  reasons), spill rate (requests routed off their affinity replica),
+  and handoff counts, from the same ``kind="request"`` records (which
+  carry ``replica_id``/``rejected``/``reject_reason``/``spilled``) plus
+  the ``kind="fleet_summary"`` rollup.
 
 Usage:
     python scripts/telemetry_report.py RUN.jsonl [SERVE.jsonl ...] [--json]
@@ -204,6 +210,77 @@ def serving_section(records: List[dict], out: dict) -> List[str]:
     return lines
 
 
+def fleet_section(records: List[dict], out: dict) -> List[str]:
+    """Per-replica latency percentiles + shed/spill accounting from the
+    fleet-stamped request records (``replica_id`` present since round
+    10) and the ``kind="fleet_summary"`` rollup."""
+    reqs = [r for r in records
+            if r.get("kind") == "request" and "replica_id" in r]
+    summaries = [r for r in records if r.get("kind") == "fleet_summary"]
+    if not reqs and not summaries:
+        return []
+    lines = ["== fleet =="]
+    served = [r for r in reqs if not r.get("rejected")]
+    shed = [r for r in reqs if r.get("rejected")]
+    spilled = sum(1 for r in served if r.get("spilled"))
+    by_rep: dict = {}
+    for r in served:
+        by_rep.setdefault(r["replica_id"], []).append(r)
+    out["fleet_replicas"] = len(by_rep)
+    out["fleet_requests"] = len(reqs)
+    out["fleet_shed"] = len(shed)
+    out["fleet_shed_rate"] = (
+        round(len(shed) / len(reqs), 4) if reqs else 0.0
+    )
+    out["fleet_spill_rate"] = (
+        round(spilled / len(served), 4) if served else 0.0
+    )
+    lines.append(
+        f"  {len(reqs)} requests over {len(by_rep)} replica(s); "
+        f"shed {len(shed)} ({out['fleet_shed_rate']:.1%}), "
+        f"spilled {spilled} ({out['fleet_spill_rate']:.1%})"
+    )
+    if shed:
+        reasons: dict = {}
+        for r in shed:
+            reasons[r.get("reject_reason", "?")] = (
+                reasons.get(r.get("reject_reason", "?"), 0) + 1
+            )
+        lines.append("  shed reasons: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(reasons.items())
+        ))
+    for rep_id, rs in sorted(by_rep.items()):
+        cells = [f"{len(rs)} reqs"]
+        for name, key in (("ttft", "ttft_s"), ("queue", "queue_wait_s")):
+            ps = percentiles([r[key] for r in rs if key in r])
+            if not ps:
+                continue
+            cells.append(
+                f"{name} " + "/".join(
+                    f"{ps[q] * 1e3:.1f}" for q in ("p50", "p95", "p99")
+                ) + "ms"
+            )
+            for q in ("p50", "p95", "p99"):
+                out[f"fleet_r{rep_id}_{name}_{q}_ms"] = round(
+                    ps[q] * 1e3, 3
+                )
+        lines.append("  " + f"replica {rep_id}".ljust(12)
+                     + "  ".join(str(c).rjust(30) for c in cells))
+    if summaries:
+        s = summaries[-1]
+        for k in ("handoffs", "recommended_replicas_peak", "replicas",
+                  "disaggregated"):
+            if k in s:
+                out[f"fleet_{k}"] = s[k]
+        if s.get("handoffs"):
+            lines.append(
+                f"  {s['handoffs']} prefill→decode handoffs"
+                + (f", mean {s['handoff_mean_s'] * 1e3:.2f}ms"
+                   if "handoff_mean_s" in s else "")
+            )
+    return lines
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("paths", nargs="+", help="telemetry JSONL file(s)")
@@ -211,9 +288,10 @@ def main(argv=None) -> int:
                    help="append one flat JSON dict (bench.py style)")
     p.add_argument("--require", default=None,
                    help="comma list of sections that MUST be present "
-                        "(goodput, serving, warmup) — exit non-zero "
-                        "otherwise; the ci_check.sh --telemetry-smoke "
-                        "and --warmup-smoke gates")
+                        "(goodput, serving, warmup, fleet) — exit "
+                        "non-zero otherwise; the ci_check.sh "
+                        "--telemetry-smoke, --warmup-smoke and "
+                        "--fleet-smoke gates")
     args = p.parse_args(argv)
 
     records = load_records(args.paths)
@@ -223,6 +301,7 @@ def main(argv=None) -> int:
     lines += warmup_section(records, out)
     lines += train_section(records, out)
     lines += serving_section(records, out)
+    lines += fleet_section(records, out)
     if not lines:
         print(f"no telemetry records in {args.paths}", file=sys.stderr)
         return 2
@@ -230,12 +309,13 @@ def main(argv=None) -> int:
     has_goodput = "goodput_frac" in out
     has_latency = "serving_ttft_p50_ms" in out
     has_warmup = "warmup_programs" in out
-    if not (has_goodput or has_latency or has_warmup):
-        print("no goodput record, serving latencies, or warmup manifest "
-              "found", file=sys.stderr)
+    has_fleet = "fleet_replicas" in out
+    if not (has_goodput or has_latency or has_warmup or has_fleet):
+        print("no goodput record, serving latencies, warmup manifest, or "
+              "fleet records found", file=sys.stderr)
         return 2
     required = {s for s in (args.require or "").split(",") if s}
-    unknown = required - {"goodput", "serving", "warmup"}
+    unknown = required - {"goodput", "serving", "warmup", "fleet"}
     if unknown:
         print(f"--require: unknown sections {sorted(unknown)}",
               file=sys.stderr)
@@ -249,6 +329,10 @@ def main(argv=None) -> int:
         return 2
     if "warmup" in required and not has_warmup:
         print("--require warmup: no warmup manifest records found",
+              file=sys.stderr)
+        return 2
+    if "fleet" in required and not has_fleet:
+        print("--require fleet: no fleet request records found",
               file=sys.stderr)
         return 2
     if args.json:

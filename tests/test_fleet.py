@@ -1,0 +1,440 @@
+"""Serving fleet layer (round 10 tentpole): seeded traces, SLO gate
+routing, session affinity + spill + shed, graceful drain (zero leaked
+blocks), disaggregated prefill/decode token identity, KV handoff
+exactness, fleet-wide registry coverage, and the telemetry fleet
+section."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.fleet import (
+    FleetRouter,
+    SLOConfig,
+    SLOGate,
+    clamp_trace,
+    generate_trace,
+    load_trace,
+    prompt_for,
+    recommend_replicas,
+    replay_trace,
+    save_trace,
+)
+from pytorch_distributed_tpu.models.generate import generate
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.serving import PagedEngine, Scheduler
+from pytorch_distributed_tpu.serving.engine import ChunkJob
+
+
+def setup(max_seq_len=64, **over):
+    cfg = tiny_config(attention="dense", max_seq_len=max_seq_len, **over)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+SCHED_KW = dict(n_slots=3, block_len=8, prefill_chunk=16,
+                admit_per_step=4)
+
+
+# ---------------------------------------------------------------------------
+# traffic traces (pure host logic — fast)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_generator_seeded_bursty_heavy_tail():
+    kw = dict(seed=3, duration_s=300.0, base_rate=1.0,
+              burst_rate_mult=6.0, burst_every_s=30.0, burst_len_s=3.0,
+              prompt_median=24, prompt_sigma=0.9, prompt_max=512,
+              max_new_median=8)
+    a = generate_trace(**kw)
+    assert a == generate_trace(**kw)  # deterministic per seed
+    assert a != generate_trace(**{**kw, "seed": 4})
+    times = np.array([r.t for r in a])
+    assert (np.diff(times) >= 0).all() and times[-1] < 300.0
+    # bursts: arrival density inside burst windows well above outside
+    in_burst = (times % 30.0) < 3.0
+    rate_in = in_burst.sum() / (300 / 30 * 3)
+    rate_out = (~in_burst).sum() / (300 - 300 / 30 * 3)
+    assert rate_in > 2.5 * rate_out
+    # heavy tail: p99 prompt length is a multiple of the median
+    lens = np.array([r.prompt_len for r in a])
+    assert np.percentile(lens, 99) > 3 * np.median(lens)
+    # sessions repeat (affinity has something to bite on)
+    sessions = [r.session for r in a]
+    assert len(set(sessions)) < len(sessions)
+
+
+def test_trace_jsonl_roundtrip_and_clamp(tmp_path):
+    trace = generate_trace(seed=1, duration_s=20.0, base_rate=2.0,
+                           prompt_max=None)
+    path = str(tmp_path / "trace.jsonl")
+    save_trace(path, trace, seed=1)
+    loaded = load_trace(path)
+    assert [(r.rid, r.session, r.prompt_len, r.max_new) for r in loaded] \
+        == [(r.rid, r.session, r.prompt_len, r.max_new) for r in trace]
+    np.testing.assert_allclose([r.t for r in loaded],
+                               [r.t for r in trace], atol=1e-6)
+    header = json.loads(open(path).readline())
+    assert header["kind"] == "trace_header" and header["seed"] == 1
+    # clamp fits any trace to a serving config's admission contract
+    clamped = clamp_trace(trace, max_seq_len=64, chunk=16)
+    for r in clamped:
+        padded = -(-r.prompt_len // 16) * 16
+        assert padded <= 64 and r.prompt_len + r.max_new <= 64
+        assert r.prompt_len >= 1 and r.max_new >= 1
+    # arrival times and sessions (the traffic shape) survive clamping
+    assert [r.t for r in clamped] == [r.t for r in trace]
+
+
+def test_replay_trace_step_mapping():
+    from pytorch_distributed_tpu.fleet import TraceRequest
+
+    trace = [TraceRequest(i, t, 0, 4, 2)
+             for i, t in enumerate([0.0, 0.5, 1.0, 2.2])]
+    submitted, ticks = [], []
+    replay_trace(
+        trace,
+        lambda r: submitted.append((len(ticks), r.rid)),
+        lambda: ticks.append(None),
+        lambda: len(submitted) == 4,
+        tick_s=1.0,
+    )
+    # arrival t maps to the first tick k with t <= k*tick_s
+    assert submitted == [(0, 0), (1, 1), (1, 2), (3, 3)]
+
+
+# ---------------------------------------------------------------------------
+# SLO gate + autoscaler (pure policy — fast)
+# ---------------------------------------------------------------------------
+
+
+def _m(queue=0, occ=0.0, ttft_p95=0.0, qw_p95=0.0, draining=False,
+       occ_mean=0.5, goodput=0.9):
+    return {"queue_depth": queue, "occupancy": occ,
+            "ttft_p95_s": ttft_p95, "queue_wait_p95_s": qw_p95,
+            "draining": draining, "occupancy_mean": occ_mean,
+            "goodput_frac": goodput}
+
+
+def test_slo_gate_routing_decisions():
+    gate = SLOGate(SLOConfig(ttft_p95_ms=100.0, spill_queue_depth=2,
+                             shed_queue_depth=4))
+    # affinity replica cool -> admit there, even if others are cooler
+    d = gate.route({0: _m(queue=1), 1: _m(queue=0)}, preferred=0)
+    assert d == ("admit", 0, "")
+    # affinity replica hot (queue) -> spill to the cool one, reason kept
+    d = gate.route({0: _m(queue=2), 1: _m(queue=0)}, preferred=0)
+    assert d.action == "spill" and d.replica == 1
+    assert d.reason == "queue_depth"
+    # live TTFT p95 past the SLO is a hot signal too
+    d = gate.route({0: _m(ttft_p95=0.2), 1: _m()}, preferred=0)
+    assert d.action == "spill" and d.reason == "slo_ttft_p95"
+    # no session: least-loaded cool replica, plain admit
+    d = gate.route({0: _m(queue=1), 1: _m(queue=0)}, preferred=None)
+    assert d == ("admit", 1, "")
+    # every replica hot but none past the shed bound: queue (admit) on
+    # the least-loaded — backpressure, not failure
+    d = gate.route({0: _m(queue=3), 1: _m(queue=2)}, preferred=None)
+    assert d.action == "admit" and d.replica == 1
+    # every replica past the shed bound: explicit reject with reason
+    d = gate.route({0: _m(queue=4), 1: _m(queue=5)}, preferred=0)
+    assert d.action == "shed" and d.replica == -1
+    assert d.reason == "queue_depth"
+    # draining replicas are routed around
+    d = gate.route({0: _m(draining=True), 1: _m()}, preferred=0)
+    assert d.action == "spill" and d.replica == 1
+    assert d.reason == "draining"
+
+
+def test_autoscaler_recommendation():
+    gate = SLOGate(SLOConfig(spill_queue_depth=2, shed_queue_depth=8))
+    # every replica hot -> scale up
+    assert recommend_replicas(2, [_m(queue=3), _m(queue=2)], gate) == 3
+    # provably idle -> scale down (but never below 1)
+    idle = _m(queue=0, occ_mean=0.05)
+    assert recommend_replicas(2, [idle, idle], gate) == 1
+    assert recommend_replicas(1, [idle], gate) == 1
+    # compile-bound "idle" is warming up, not idle -> hold
+    warming = _m(queue=0, occ_mean=0.05, goodput=0.2)
+    assert recommend_replicas(2, [warming, warming], gate) == 2
+    # mixed load -> hold
+    assert recommend_replicas(2, [_m(queue=3), _m(queue=0)], gate) == 2
+
+
+# ---------------------------------------------------------------------------
+# router: affinity, spill, shed
+# ---------------------------------------------------------------------------
+
+
+def test_router_session_affinity():
+    cfg, params = setup()
+    r = FleetRouter(cfg, params, n_replicas=2, **SCHED_KW)
+    prompt = np.arange(1, 10, dtype=np.int32)
+    rids = []
+    for _ in range(3):
+        rids.append(r.submit(prompt, 2, session=7))
+        r.drain()  # fully drain between submits: no load pressure
+    home = r.placement[rids[0]]
+    assert all(r.placement[rid] == home for rid in rids)
+    # a different session lands by load, independent of session 7's home
+    assert r._affinity == {7: home}
+
+
+def test_router_spill_on_hot_replica():
+    cfg, params = setup()
+    r = FleetRouter(cfg, params, n_replicas=2,
+                    slo=SLOConfig(spill_queue_depth=2,
+                                  shed_queue_depth=64),
+                    **SCHED_KW)
+    prompt = np.arange(1, 10, dtype=np.int32)
+    # no ticks between submits: session 5's home replica queues up to
+    # the spill bound, then the gate routes around it
+    rids = [r.submit(prompt, 2, session=5) for _ in range(6)]
+    home = r.placement[rids[0]]
+    placements = [r.placement[rid] for rid in rids]
+    assert placements.count(home) >= 2  # queued up to the bound at home
+    assert 1 - home in placements      # then spilled to the other
+    assert r._spilled > 0
+    assert r._affinity[5] == home      # affinity sticks through spills
+    out = r.drain()
+    assert len(out) == 6 and not r.rejected
+
+
+def test_router_shed_under_burst_only_when_slo_violated():
+    cfg, params = setup()
+    slo = SLOConfig(spill_queue_depth=1, shed_queue_depth=2)
+    prompt = np.arange(1, 14, dtype=np.int32)
+    # gentle load: drain between submits -> zero rejects
+    r = FleetRouter(cfg, params, n_replicas=1, slo=slo, **SCHED_KW)
+    for _ in range(4):
+        r.submit(prompt, 2, session=1)
+        r.drain()
+    assert not r.rejected
+    # burst: everything at once -> queue passes the shed bound and the
+    # overflow is explicitly rejected with a reason
+    r = FleetRouter(cfg, params, n_replicas=1, slo=slo, **SCHED_KW)
+    rids = [r.submit(prompt, 2, session=1) for _ in range(8)]
+    assert r.rejected, "burst past the shed bound must shed"
+    assert all(reason == "queue_depth" for reason in r.rejected.values())
+    out = r.drain()
+    served = [rid for rid in rids if rid not in r.rejected]
+    assert set(out) == set(served)  # shed rids never stream tokens
+    m = r.metrics()
+    assert m["shed"] == len(r.rejected) and m["shed_rate"] > 0
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (scale-down primitive)
+# ---------------------------------------------------------------------------
+
+
+def test_graceful_drain_zero_leaked_blocks():
+    cfg, params = setup()
+    s = Scheduler(cfg, params, **SCHED_KW)
+    prompt = np.arange(1, 20, dtype=np.int32)
+    rids = [s.submit(prompt, 3) for _ in range(6)]
+    pre: dict = {}
+    for _ in range(2):  # some in flight, some still queued
+        for rid, tok in s.step():
+            pre.setdefault(rid, []).append(tok)
+    in_flight = {r.rid for r in s.resident.values()}
+    assert in_flight and len(s.queue) > 0
+    produced, requeued = s.drain_graceful()
+    # in-flight requests ran to completion; queued ones came back intact
+    assert set(produced) == in_flight
+    assert all(
+        len(pre.get(rid, [])) + len(toks) == 3
+        for rid, toks in produced.items()
+    )
+    assert {r.rid for r in requeued} == set(rids) - in_flight
+    # zero leaked pool blocks, and the replica refuses new work
+    assert s.engine.allocator.in_use == 0
+    assert not s.resident and s.draining
+    with pytest.raises(RuntimeError, match="draining"):
+        s.submit(prompt, 2)
+    s.engine.release_all()  # teardown is a no-op by then
+    assert s.engine.allocator.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode
+# ---------------------------------------------------------------------------
+
+
+def test_handoff_export_import_blocks_exact():
+    cfg, params = setup()
+    src = PagedEngine(cfg, params, 2, block_len=8, prefill_chunk=16,
+                      handoff=True)
+    dst = PagedEngine(cfg, params, 3, block_len=8, prefill_chunk=16,
+                      handoff=True)
+    prompt = np.arange(1, 14, dtype=np.int32)  # 13 tokens, chunk 16
+    assert src.admit(0, len(prompt), 3)
+    tokens = np.zeros((16,), np.int32)
+    tokens[:13] = prompt
+    src.run_chunks([ChunkJob(slot=0, tokens=tokens, start=0,
+                             is_last=True, last_idx=12)])
+    export = src.export_chain(0)
+    assert export.n_blocks == len(src.allocator.chain(0))
+    # occupy dst slot 0 first so the imported chain lands elsewhere —
+    # block ids must NOT need to agree between pools
+    assert dst.admit(0, 8, 2)
+    assert dst.import_chain(1, export)
+    src_chain = src.allocator.chain(0)
+    dst_chain = dst.allocator.chain(1)
+    src_leaves = jax.tree.leaves(src.cache)
+    dst_leaves = jax.tree.leaves(dst.cache)
+    for s_leaf, d_leaf in zip(src_leaves, dst_leaves):
+        np.testing.assert_array_equal(
+            np.asarray(s_leaf[np.asarray(src_chain)]),
+            np.asarray(d_leaf[np.asarray(dst_chain)]),
+        )
+    np.testing.assert_array_equal(np.asarray(src.logits[0]),
+                                  np.asarray(dst.logits[1]))
+    # table remap points the dst slot at its own chain
+    assert list(dst.tables[1, :len(dst_chain)]) == dst_chain
+    # a full pool is a deterministic False, state unchanged
+    assert dst.admit(2, 60, 2) or True  # fill what's left
+    before = dst.allocator.in_use
+    third = PagedEngine(cfg, params, 1, n_blocks=2, block_len=8,
+                        prefill_chunk=16, handoff=True)
+    assert not third.import_chain(0, export)  # 1 free block < chain
+    assert third.allocator.in_use == 0
+    assert dst.allocator.in_use == before
+
+
+def test_handoff_requires_flag():
+    cfg, params = setup()
+    eng = PagedEngine(cfg, params, 2, block_len=8, prefill_chunk=16)
+    eng.admit(0, 9, 2)
+    with pytest.raises(RuntimeError, match="handoff=True"):
+        eng.export_chain(0)
+    assert eng.handoff_buckets() == []  # registry predicts none
+
+
+def test_disagg_token_identical_to_colocated():
+    cfg, params = setup()
+    rng = np.random.default_rng(0)
+    # lengths straddling chunk boundaries, incl. an exact multiple
+    lens = [5, 16, 23, 31, 9, 17]
+    prompts = [rng.integers(1, cfg.vocab_size, l).astype(np.int32)
+               for l in lens]
+    ref = Scheduler(cfg, params, **SCHED_KW)
+    for p in prompts:
+        ref.submit(p, 5)
+    want = ref.drain()
+    # disaggregated: 1 prefill + 1 decode replica, role-sized decode,
+    # handoff budget exercised
+    r = FleetRouter(cfg, params, n_replicas=2, disaggregate=True,
+                    decode_slots=4, handoffs_per_tick=1, **SCHED_KW)
+    for p in prompts:
+        r.submit(p, 5)
+    got = r.drain()
+    assert set(got) == set(want)
+    for rid in want:
+        assert got[rid] == want[rid], f"stream {rid} diverged"
+    m = r.metrics()
+    assert m["handoffs"] == len(prompts)
+    # every pool block freed once everything retired
+    for s in r.replicas:
+        assert s.engine.allocator.in_use == 0
+    # greedy decode against the plain generate() reference too
+    full = generate(
+        cfg, params, jnp.asarray(prompts[0])[None, :], jax.random.key(1),
+        max_new_tokens=5, temperature=0.0,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(full)[0, len(prompts[0]):], got[0]
+    )
+
+
+def test_fleet_registry_coverage_across_replicas():
+    from pytorch_distributed_tpu.compilecache import CoverageError
+
+    cfg, params = setup()
+    r = FleetRouter(cfg, params, n_replicas=2, disaggregate=True,
+                    **SCHED_KW)
+    prompt = np.arange(1, 20, dtype=np.int32)
+    for _ in range(3):
+        r.submit(prompt, 3, session=2)
+    r.drain()
+    # every replica compiled something, incl. the handoff programs
+    names = [n for s in r.replicas
+             for n in s.engine.compiled_program_names()]
+    assert any(n.startswith("kv_export") for n in names)
+    assert any(n.startswith("kv_import") for n in names)
+    r.assert_registry_covers()  # fleet-wide coverage guard green
+    # the guard has teeth: a rogue program fails it
+    regs = r.registries()
+    with pytest.raises(CoverageError, match="rogue"):
+        regs[0].assert_covers(["rogue"])
+
+
+# ---------------------------------------------------------------------------
+# JSONL schema + telemetry report fleet section
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_jsonl_schema_and_report_section(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = os.path.join(repo, "scripts", "telemetry_report.py")
+    cfg, params = setup()
+    path = str(tmp_path / "fleet.jsonl")
+    with MetricsLogger(path) as mlog:
+        r = FleetRouter(cfg, params, n_replicas=2,
+                        slo=SLOConfig(spill_queue_depth=1,
+                                      shed_queue_depth=2),
+                        metrics_log=mlog, **SCHED_KW)
+        prompt = np.arange(1, 18, dtype=np.int32)
+        for i in range(8):
+            r.submit(prompt, 2, session=i % 3)
+        r.drain()
+        r.log_summary()
+    assert r.rejected and r._spilled  # the run exercised shed AND spill
+    records = [json.loads(line) for line in open(path)]
+    reqs = [rec for rec in records if rec.get("kind") == "request"]
+    served = [rec for rec in reqs if not rec["rejected"]]
+    shed = [rec for rec in reqs if rec["rejected"]]
+    assert served and shed
+    for rec in served:
+        assert rec["replica_id"] in (0, 1)
+        assert "ttft_steps" in rec and rec["ttft_steps"] >= 1
+        assert "session" in rec and "spilled" in rec
+    for rec in shed:
+        assert rec["reject_reason"] == "queue_depth"
+        assert rec["new_tokens"] == 0
+    assert any(rec.get("kind") == "fleet_summary" for rec in records)
+    # the report renders the fleet section and honors --require fleet
+    proc = subprocess.run(
+        [sys.executable, report, path, "--json", "--require", "fleet"],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "== fleet ==" in proc.stdout
+    flat = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert flat["fleet_replicas"] == 2
+    assert flat["fleet_shed_rate"] > 0
+    assert flat["fleet_spill_rate"] > 0
+    assert "fleet_r0_ttft_p95_ms" in flat
+    # --require fleet fails on a fleet-less stream
+    lonely = str(tmp_path / "lonely.jsonl")
+    with open(lonely, "w") as f:
+        f.write(json.dumps({"kind": "train", "step": 1}) + "\n")
+    proc = subprocess.run(
+        [sys.executable, report, lonely, "--require", "fleet"],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert proc.returncode != 0
