@@ -58,6 +58,13 @@ class ProgramSpec:
     step of a non-drop_last loader may hold one per distinct batch shape);
     ``cache_probe`` returns the live count when the program is backed by a
     single jit callable (None when it is not observable that way).
+
+    ``aot`` (ISSUE 8, cost cards) returns the program's ``jax.stages.
+    Compiled`` — ``lower(...).compile()`` at the spec's real avals — so
+    ``telemetry.costmodel`` can pull ``cost_analysis()`` /
+    ``memory_analysis()`` for every enumerated program. Calling it pays
+    a trace + compile (a disk hit under ``enable_persistent_cache``);
+    card builders invoke it on demand, off the hot path.
     """
 
     name: str
@@ -65,6 +72,7 @@ class ProgramSpec:
     priority: int = 1  # 0 = serve-critical: compiled first, foreground
     expect_entries: int = 1
     cache_probe: Optional[Callable[[], Optional[int]]] = None
+    aot: Optional[Callable[[], object]] = None
 
 
 class ProgramRegistry:
@@ -207,6 +215,7 @@ def aot_spec(
         priority=priority,
         expect_entries=expect_entries,
         cache_probe=lambda: jit_cache_size(jit_fn),
+        aot=lambda: jit_fn.lower(*avals_thunk()).compile(),
     )
 
 
@@ -241,6 +250,7 @@ def serving_registry(engine, extra: Iterable = ()) -> ProgramRegistry:
         name=engine.DECODE_PROGRAM,
         warm=lambda execute: engine.warm_decode(execute=execute),
         priority=0,
+        aot=lambda: engine.warm_decode(execute=False),
     ))
     buckets = engine.chunk_buckets()
     smallest = min(buckets) if buckets else None
@@ -250,6 +260,8 @@ def serving_registry(engine, extra: Iterable = ()) -> ProgramRegistry:
             warm=(lambda execute, k=k_pad, w=wp:
                   engine.warm_chunk(k, w, execute=execute)),
             priority=0 if (k_pad, wp) == smallest else 1,
+            aot=(lambda k=k_pad, w=wp:
+                 engine.warm_chunk(k, w, execute=False)),
         ))
     # fleet disaggregation handoff programs (empty unless the engine was
     # built with handoff=True — read from the engine for the same
@@ -259,10 +271,12 @@ def serving_registry(engine, extra: Iterable = ()) -> ProgramRegistry:
             name=engine.export_program_name(n_pad),
             warm=(lambda execute, n=n_pad:
                   engine.warm_export(n, execute=execute)),
+            aot=lambda n=n_pad: engine.warm_export(n, execute=False),
         ))
         reg.add(ProgramSpec(
             name=engine.import_program_name(n_pad),
             warm=(lambda execute, n=n_pad:
                   engine.warm_import(n, execute=execute)),
+            aot=lambda n=n_pad: engine.warm_import(n, execute=False),
         ))
     return reg

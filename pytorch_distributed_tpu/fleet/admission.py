@@ -89,7 +89,11 @@ class SLOGate:
     def hot(self, m: dict) -> Optional[str]:
         """The first SLO signal this replica violates, or None while it
         has headroom. Draining replicas are permanently hot — the gate
-        routes around them during scale-down."""
+        routes around them during scale-down. A replica whose anomaly
+        sentinel fired recently (``anomaly_recent``, ISSUE 8 — a
+        tick-time/TTFT/queue-depth z-score excursion) is hot too: the
+        gate spills around a replica that is *degrading* before its p95
+        series has drifted far enough to breach the SLO itself."""
         if m.get("draining"):
             return "draining"
         if m["queue_depth"] >= self.slo.spill_queue_depth:
@@ -98,6 +102,8 @@ class SLOGate:
             return "slo_ttft_p95"
         if m.get("queue_wait_p95_s", 0.0) * 1e3 > self.slo.queue_wait_p95_ms:
             return "slo_queue_wait_p95"
+        if m.get("anomaly_recent"):
+            return "anomaly"
         return None
 
     def overloaded(self, m: dict) -> bool:

@@ -78,8 +78,10 @@ class FleetRouter:
                  handoffs_per_tick: Optional[int] = None,
                  slo: Optional[SLOConfig] = None, devices=None,
                  seed: int = 0, metrics_log=None, tracer=None,
-                 **scheduler_kwargs):
+                 flightrec=None, **scheduler_kwargs):
         import jax
+
+        from pytorch_distributed_tpu.telemetry import NULL_RECORDER
 
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -95,6 +97,10 @@ class FleetRouter:
             devices = jax.devices()
         self.gate = SLOGate(slo)
         self.metrics_log = metrics_log
+        # fleet forensics (ISSUE 8): routing decisions — sheds, spills,
+        # handoffs — land in the shared flight-recorder ring, so a
+        # post-mortem dump shows WHY requests went where before death
+        self.flightrec = flightrec if flightrec is not None else NULL_RECORDER
         self.replicas: List[Scheduler] = []
         self.roles: List[str] = []
         for i in range(n_replicas):
@@ -120,7 +126,7 @@ class FleetRouter:
                 config, params, replica_id=i, seed=seed + i,
                 prefill_only=(role == "prefill"), device=dev,
                 handoff=disaggregate, metrics_log=metrics_log,
-                tracer=tracer, **kw,
+                tracer=tracer, flightrec=self.flightrec, **kw,
             ))
             self.roles.append(role)
         self.disaggregated = disaggregate
@@ -173,6 +179,7 @@ class FleetRouter:
         )
         if decision.action == SHED:
             self.rejected[rid] = decision.reason
+            self.flightrec.record("shed", rid=rid, reason=decision.reason)
             if self.metrics_log is not None:
                 self.metrics_log.log(
                     kind="request", rid=rid,
@@ -188,6 +195,9 @@ class FleetRouter:
             self._affinity[session] = target
         if decision.action == SPILL:
             self._spilled += 1
+            self.flightrec.record(
+                "spill", rid=rid, to=target, reason=decision.reason
+            )
         self.replicas[target].submit(
             prompt, max_new_tokens, session=session,
             spilled=(decision.action == SPILL), rid=rid,
@@ -228,6 +238,9 @@ class FleetRouter:
                 self.handoff_lat.observe(time.perf_counter() - t0)
                 self.placement[rid] = adopted_by
                 self._handoff_count += 1
+                self.flightrec.record(
+                    "handoff", rid=rid, src=pi, dst=adopted_by
+                )
                 budget -= 1
 
     def step(self) -> List[Tuple[int, int]]:

@@ -66,6 +66,11 @@ class Watchdog:
                     clears a fired stall, the whole beat-to-beat gap is
                     classified as ``stall`` time (the step made no
                     progress while the watchdog was screaming).
+    ``flightrec``   optional ``telemetry.FlightRecorder``: a stall
+                    records one ring event and — with
+                    ``flightrec_path`` set — atomically dumps the ring
+                    next to the stack dump, so the post-mortem has the
+                    run's recent HISTORY, not just its frozen stacks.
     """
 
     def __init__(
@@ -79,6 +84,8 @@ class Watchdog:
         grace_s: float = 10.0,
         poll_s: Optional[float] = None,
         ledger=None,
+        flightrec=None,
+        flightrec_path: Optional[str] = None,
     ):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
@@ -89,6 +96,8 @@ class Watchdog:
         self.exit_code = exit_code
         self.grace_s = float(grace_s)
         self.ledger = ledger
+        self.flightrec = flightrec
+        self.flightrec_path = flightrec_path
         self.poll_s = float(poll_s) if poll_s else min(
             1.0, self.timeout_s / 4.0
         )
@@ -183,6 +192,13 @@ class Watchdog:
                     )
             except OSError as e:
                 logger.error("watchdog: could not write dump: %s", e)
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "watchdog_stall", n=self.stalls,
+                stalled_s=round(stalled_s, 3),
+            )
+            if self.flightrec_path:
+                self.flightrec.dump(self.flightrec_path, "watchdog_stall")
         if self.watcher is not None:
             # soft-stall path: the next step's suspend poll checkpoints
             # and yields through the existing, tested machinery

@@ -355,23 +355,25 @@ class PagedEngine:
         ns.append(self.table_width)
         return sorted(set(ns))
 
-    def warm_export(self, n_pad: int, execute: bool = True) -> None:
+    def warm_export(self, n_pad: int, execute: bool = True):
         """Compile (and inertly run) one export bucket: reading the
-        trash block and slot 0's logits row mutates nothing."""
+        trash block and slot 0's logits row mutates nothing. The
+        ``execute=False`` branch returns the ``Compiled`` (cost-card
+        statics, ``telemetry.costmodel``); the execute branch None."""
         fn = self._export_fn(n_pad)
         idx = jnp.full((n_pad,), TRASH_BLOCK, jnp.int32)
         slot = jnp.asarray(0, jnp.int32)
         if execute:
             fn(self.cache, self.logits, idx, slot)
-        else:
-            cache_aval, logits_aval = self._cache_logits_avals()
-            fn.lower(cache_aval, logits_aval, idx, slot).compile()
+            return None
+        cache_aval, logits_aval = self._cache_logits_avals()
+        return fn.lower(cache_aval, logits_aval, idx, slot).compile()
 
-    def warm_import(self, n_pad: int, execute: bool = True) -> None:
+    def warm_import(self, n_pad: int, execute: bool = True):
         """Compile (and inertly run) one import bucket: every lane
         scatters into the trash block and the logits row targets the
         out-of-bounds ``n_slots`` sentinel (dropped), so live state is
-        untouched."""
+        untouched. ``execute=False`` returns the ``Compiled``."""
         fn = self._import_fn(n_pad)
         blocks = jax.tree.map(
             lambda pool: jnp.zeros((n_pad,) + pool.shape[1:], pool.dtype),
@@ -384,11 +386,11 @@ class PagedEngine:
             self.cache, self.logits = fn(
                 self.cache, self.logits, blocks, idx, slot, row,
             )
-        else:
-            cache_aval, logits_aval = self._cache_logits_avals()
-            fn.lower(
-                cache_aval, logits_aval, blocks, idx, slot, row
-            ).compile()
+            return None
+        cache_aval, logits_aval = self._cache_logits_avals()
+        return fn.lower(
+            cache_aval, logits_aval, blocks, idx, slot, row
+        ).compile()
 
     def has_chunk_program(self, k_pad: int, wp: int) -> bool:
         """True when the bucket's call path is hot (executed before)."""
@@ -416,9 +418,10 @@ class PagedEngine:
         )
         return jax.tree.map(sds, self.cache), sds(self.logits)
 
-    def warm_chunk(self, k_pad: int, wp: int, execute: bool = True) -> None:
+    def warm_chunk(self, k_pad: int, wp: int, execute: bool = True):
         """Force the (k_pad, wp) chunk program compiled before traffic
-        needs it.
+        needs it. ``execute=False`` returns the ``Compiled`` (cost-card
+        statics); the execute branch returns None.
 
         ``execute=True`` runs it once with inert inputs — every job is a
         padding job (slot ``n_slots``: the logits scatter drops it) whose
@@ -450,19 +453,19 @@ class PagedEngine:
                 tables, slots, is_last, last_idx,
             )
             self._hot_chunks.add((k_pad, wp))
-        else:
-            cache_aval, logits_aval = self._cache_logits_avals()
-            fn.lower(
-                self.params, cache_aval, logits_aval, tokens, starts,
-                tables, slots, is_last, last_idx,
-            ).compile()
+            return None
+        cache_aval, logits_aval = self._cache_logits_avals()
+        return fn.lower(
+            self.params, cache_aval, logits_aval, tokens, starts,
+            tables, slots, is_last, last_idx,
+        ).compile()
 
-    def warm_decode(self, execute: bool = True) -> None:
-        """Force the decode tick compiled — same contract as
-        ``warm_chunk``. The inert execution decodes with every lane
-        inactive: cache writes go to the trash block and the logits
-        buffer's garbage rows are rewritten by each slot's final prefill
-        chunk before any real decode reads them."""
+    def warm_decode(self, execute: bool = True):
+        """Force the decode tick compiled — same contract (and return
+        convention) as ``warm_chunk``. The inert execution decodes with
+        every lane inactive: cache writes go to the trash block and the
+        logits buffer's garbage rows are rewritten by each slot's final
+        prefill chunk before any real decode reads them."""
         fn = self._decode()
         positions = jnp.zeros((self.n_slots,), jnp.int32)
         active = jnp.zeros((self.n_slots,), bool)
@@ -477,12 +480,12 @@ class PagedEngine:
                 tables, rng,
             )
             self._hot_decode = True
-        else:
-            cache_aval, logits_aval = self._cache_logits_avals()
-            fn.lower(
-                self.params, cache_aval, logits_aval, positions, active,
-                tables, rng,
-            ).compile()
+            return None
+        cache_aval, logits_aval = self._cache_logits_avals()
+        return fn.lower(
+            self.params, cache_aval, logits_aval, positions, active,
+            tables, rng,
+        ).compile()
 
     # ---- slot-level operations ----
 

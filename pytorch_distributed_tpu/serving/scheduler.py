@@ -59,9 +59,12 @@ import numpy as np
 
 from pytorch_distributed_tpu.compilecache.aot import attribute_compile
 from pytorch_distributed_tpu.telemetry import (
+    NULL_RECORDER,
     NULL_TRACER,
+    AnomalySentinel,
     GoodputLedger,
     LatencySeries,
+    ProgramTimes,
 )
 
 
@@ -122,7 +125,8 @@ class Scheduler:
                  seed: int = 0, eos_id: Optional[int] = None, mesh=None,
                  tracer=None, metrics_log=None, replica_id: int = 0,
                  prefill_only: bool = False, device=None,
-                 handoff: bool = False):
+                 handoff: bool = False, flightrec=None,
+                 anomaly_threshold: float = 8.0):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
 
         if eos_id is not None and not 0 <= eos_id < config.vocab_size:
@@ -193,6 +197,35 @@ class Scheduler:
         # starts compare on one number — goodput compile fraction
         self.goodput = GoodputLedger()
         self.goodput.start()
+        # ---- attribution & forensics (ISSUE 8) ----
+        # per-program measured wall for the cost-card join: the chunk
+        # program of each tick's bucket, and the decode tick (whose
+        # tokens materialize inside engine.decode, so its wall is honest
+        # device+sync time, not bare dispatch)
+        self.prog_times = ProgramTimes()
+        self.flightrec = flightrec if flightrec is not None else NULL_RECORDER
+        # anomaly sentinel over tick time / TTFT / queue depth; a recent
+        # hit surfaces as metrics()["anomaly_recent"], which the fleet
+        # SLOGate reads as a hot signal (spill around this replica)
+        self.sentinel = (
+            AnomalySentinel(
+                threshold=anomaly_threshold, metrics_log=metrics_log,
+                flightrec=self.flightrec, source=f"replica{replica_id}",
+            )
+            if anomaly_threshold and anomaly_threshold > 0 else None
+        )
+        self._last_anomaly_step = None
+        #: ticks an anomaly stays "recent" for the SLO gate's hot signal
+        self.anomaly_recent_ticks = 64
+        if self.sentinel is not None:
+            # scale floors: a detector over a near-constant series would
+            # otherwise flag routine jitter (MAD ≈ 0 → any blip is ∞σ).
+            # Time series floor at 10 ms — a stall must clear
+            # threshold × 10 ms above baseline; queue depth floors at one
+            # whole request.
+            self.sentinel.detector("tick_time").abs_floor = 0.01
+            self.sentinel.detector("ttft").abs_floor = 0.01
+            self.sentinel.detector("queue_depth").abs_floor = 1.0
 
     # ---- API ----
 
@@ -301,6 +334,9 @@ class Scheduler:
             self._adm_latency_steps += self._step_count - req.submit_step
             self._adm_latency_s += now - req.submit_time
             self.queue_wait.observe(now - req.submit_time)
+            self.flightrec.record(
+                "admit", rid=req.rid, slot=slot, replica=self.replica_id
+            )
             admitted += 1
 
     def _chunk_jobs(self):
@@ -337,16 +373,24 @@ class Scheduler:
             # executed — the call below stalls for its compile (or a
             # persistent-cache load after an AOT-only warmup). Mark every
             # request riding the batch and book the stall as compile time.
-            cold_bucket = not self.engine.has_chunk_program(
-                *self.engine.bucket_for(jobs)
-            )
+            bucket = self.engine.bucket_for(jobs)
+            cold_bucket = not self.engine.has_chunk_program(*bucket)
             if cold_bucket:
                 for j in jobs:
                     self.resident[j.slot].cold = True
+            t_chunk = time.perf_counter()
             with self.tracer.span("prefill_chunk", jobs=len(jobs)), \
                     attribute_compile(self.goodput if cold_bucket
                                       else None):
                 self.engine.run_chunks(jobs)
+            if not cold_bucket:
+                # cost-card join: warm dispatch wall attributed to THIS
+                # bucket's program (cold calls excluded — their wall is
+                # compile, already booked to the ledger above)
+                self.prog_times.observe(
+                    self.engine.chunk_program_name(*bucket),
+                    time.perf_counter() - t_chunk,
+                )
             for j in jobs:
                 req = self.resident[j.slot]
                 req.prefill_done += self.engine.chunk
@@ -364,6 +408,7 @@ class Scheduler:
         self._occupancy_sum += len(self.resident) / self.n_slots
         self._step_count += 1
         if not active.any():
+            self._observe_tick(t_step0)
             return []
         self._rng, sub = jax.random.split(self._rng)
         cold_decode = not self.engine.has_decode_program
@@ -372,6 +417,7 @@ class Scheduler:
             # decode program's first compile — those requests are cold
             for slot in np.nonzero(active)[0]:
                 self.resident[int(slot)].cold = True
+        t_dec = time.perf_counter()
         with self.tracer.span("decode_tick", lanes=int(active.sum())), \
                 attribute_compile(self.goodput if cold_decode else None):
             tokens, self.positions = self.engine.decode(
@@ -380,6 +426,10 @@ class Scheduler:
         # engine.decode returns MATERIALIZED numpy tokens, so this
         # timestamp is token-delivery time, not dispatch time
         now = time.perf_counter()
+        if not cold_decode:
+            # cost-card join: tokens materialized above, so this wall is
+            # dispatch + device + sync — the honest decode-tick cost
+            self.prog_times.observe(self.engine.DECODE_PROGRAM, now - t_dec)
         out: List[Tuple[int, int]] = []
         for slot in np.nonzero(active)[0]:
             slot = int(slot)
@@ -390,6 +440,13 @@ class Scheduler:
                 req.first_token_time = now
                 req.first_token_step = self._step_count
                 self.ttft.observe(now - req.submit_time)
+                if self.sentinel is not None and not req.cold:
+                    # warm TTFT only: a cold request's compile stall is a
+                    # known cause, already attributed — not an anomaly
+                    self._note_anomaly(self.sentinel.observe(
+                        "ttft", now - req.submit_time, rid=req.rid,
+                        tick=self._step_count,
+                    ))
                 if not req.cold:
                     self.ttft_warm.observe(now - req.submit_time)
             else:
@@ -407,12 +464,34 @@ class Scheduler:
                 self._completed += 1
                 if req.cold:
                     self._cold_requests += 1
+                self.flightrec.record(
+                    "retire", rid=req.rid, tokens=req.produced,
+                    replica=self.replica_id,
+                )
                 self._log_request(req)
             else:
                 self.remaining[slot] -= 1
         if out:
             self.tick_lat.observe(now - t_step0)
+        self._observe_tick(t_step0)
         return out
+
+    def _note_anomaly(self, hit: Optional[dict]) -> None:
+        if hit is not None:
+            self._last_anomaly_step = self._step_count
+
+    def _observe_tick(self, t_step0: float) -> None:
+        """Per-tick sentinel feed: tick wall and queue depth (every tick,
+        both return paths of ``step``)."""
+        if self.sentinel is None:
+            return
+        self._note_anomaly(self.sentinel.observe(
+            "tick_time", time.perf_counter() - t_step0,
+            tick=self._step_count,
+        ))
+        self._note_anomaly(self.sentinel.observe(
+            "queue_depth", float(len(self.queue)), tick=self._step_count,
+        ))
 
     def _log_request(self, req: Request) -> None:
         """One ``kind="request"`` JSONL record per retirement — the raw
@@ -549,7 +628,34 @@ class Scheduler:
         self._adopted += 1
         return True
 
+    # ---- cost cards (telemetry/costmodel.py) ----
+
+    def log_cost_cards(self) -> list:
+        """One ``kind="program_cost"`` JSONL record per registry program:
+        the compiler's FLOP/byte statics joined with this scheduler's
+        measured per-program tick wall (warm calls only — compile stalls
+        are ledger ``compile`` time, not program cost). Building the
+        statics AOT-compiles each not-yet-compiled bucket (a disk hit
+        under the persistent cache), so call it once per run, after
+        traffic — never inside the serve loop. Returns the records."""
+        from pytorch_distributed_tpu.compilecache import serving_registry
+        from pytorch_distributed_tpu.telemetry import log_cost_cards
+
+        return log_cost_cards(
+            serving_registry(self.engine), self.prog_times, self.metrics_log
+        )
+
     # ---- metrics ----
+
+    @property
+    def anomaly_recent(self) -> bool:
+        """True while an anomaly lies within the last
+        ``anomaly_recent_ticks`` ticks — the SLO gate's hot signal."""
+        return (
+            self._last_anomaly_step is not None
+            and self._step_count - self._last_anomaly_step
+            <= self.anomaly_recent_ticks
+        )
 
     def metrics(self) -> dict:
         """Exact host-side accounting; all counters, no device sync."""
@@ -606,6 +712,12 @@ class Scheduler:
             # warm-only TTFT is the SLO series, plain ttft includes cold
             "cold_requests": self._cold_requests,
             "compile_s": self.goodput.seconds("compile"),
+            # anomaly sentinel (telemetry/anomaly.py): total hits and the
+            # recency flag the fleet SLOGate treats as hot
+            "anomaly_count": (
+                self.sentinel.anomalies if self.sentinel is not None else 0
+            ),
+            "anomaly_recent": self.anomaly_recent,
             # latency percentiles — the SLO surface (exact, host-side)
             **self.ttft.summary("ttft"),
             **self.ttft_warm.summary("ttft_warm"),

@@ -24,13 +24,51 @@ closes:
   summaries (TTFT, per-output-token, queue wait for the serving
   scheduler).
 
+Round 11 adds the attribution-and-forensics layer (ANALYSIS.md
+"Performance attribution & forensics"):
+
+- ``costmodel`` — per-program cost cards: ``Compiled.cost_analysis()``
+  FLOP/byte statics for every ``compilecache.ProgramRegistry`` program,
+  joined with measured span/tick times into MFU, achieved bandwidth, and
+  a compute-vs-bandwidth roofline classification (``kind="program_cost"``
+  JSONL);
+- ``anomaly`` — streaming median/MAD z-score detectors over step-time,
+  data-wait, TTFT, and queue-depth series (``kind="anomaly"`` with a
+  context window); a recently-anomalous serving replica reads as hot to
+  the fleet ``SLOGate``;
+- ``flightrec`` — a bounded ring of recent structured events, dumped
+  atomically on watchdog stall, rollback, suspend, and unhandled
+  exception, with a size-capped durable JSONL mirror the kill-matrix
+  relaunch reads;
+- ``export`` — a stdlib-HTTP Prometheus-text ``/metrics`` thread
+  (``scripts/pdt_top.py`` is the JSONL-tailing terminal twin).
+
 Everything reports through the one JSONL schema of
 ``utils.profiling.MetricsLogger``; ``scripts/telemetry_report.py``
 renders a run's JSONL into the summary table ``bench.py`` consumes.
 ANALYSIS.md "Observability & goodput" documents the schema.
 """
 
+from pytorch_distributed_tpu.telemetry.anomaly import (
+    AnomalySentinel,
+    StreamingDetector,
+)
+from pytorch_distributed_tpu.telemetry.costmodel import (
+    CostCard,
+    ProgramTimes,
+    build_cost_cards,
+    device_ceilings,
+    log_cost_cards,
+)
 from pytorch_distributed_tpu.telemetry.device_metrics import DeviceMetricsRing
+from pytorch_distributed_tpu.telemetry.export import (
+    MetricsExporter,
+    prometheus_text,
+)
+from pytorch_distributed_tpu.telemetry.flightrec import (
+    NULL_RECORDER,
+    FlightRecorder,
+)
 from pytorch_distributed_tpu.telemetry.goodput import (
     GOODPUT_CATEGORIES,
     GoodputLedger,
@@ -39,7 +77,18 @@ from pytorch_distributed_tpu.telemetry.latency import LatencySeries, percentiles
 from pytorch_distributed_tpu.telemetry.spans import NULL_TRACER, SpanTracer
 
 __all__ = [
+    "AnomalySentinel",
+    "StreamingDetector",
+    "CostCard",
+    "ProgramTimes",
+    "build_cost_cards",
+    "device_ceilings",
+    "log_cost_cards",
     "DeviceMetricsRing",
+    "MetricsExporter",
+    "prometheus_text",
+    "NULL_RECORDER",
+    "FlightRecorder",
     "GOODPUT_CATEGORIES",
     "GoodputLedger",
     "LatencySeries",

@@ -1,0 +1,150 @@
+"""Streaming anomaly sentinel: robust z-scores over the run's own series.
+
+PR 4 made every latency and step-time series exact; nothing *watched*
+them — a degrading run surfaced only when a human read the JSONL (or the
+watchdog's hard deadline fired, minutes too late). The sentinel closes
+that gap with a detector cheap enough to run on every observation:
+
+- per-series rolling window of the last ``window`` values;
+- robust center/scale: median and MAD (×1.4826, the normal-consistency
+  constant), so the baseline itself is immune to the outliers it hunts
+  and to the multi-second first-step compile that would wreck a
+  mean/stddev baseline;
+- a value is anomalous when ``|x - median| / scale > threshold`` once
+  ``min_samples`` observations exist. An all-equal window has MAD 0; the
+  scale floors at ``rel_floor·|median|`` (+ an absolute epsilon) so a
+  constant series flags genuine departures without dividing by zero.
+
+Anomalous values still ENTER the window: MAD tolerates <50% contamination,
+and absorbing them means a genuine level shift (a slower disk, a new
+steady state) stops alarming once it becomes the new normal — the
+detector flags *transitions*, not states.
+
+Each hit emits one ``kind="anomaly"`` JSONL record carrying the value,
+the baseline it violated, and a context window of the observations that
+preceded it — the forensic record ``scripts/telemetry_report.py`` and
+``scripts/pdt_top.py`` surface. Determinism: no wall clock, no RNG — the
+same series flags the same indices on every run, which is what lets
+``resilience/faults.py`` hang injection prove the sentinel in a test.
+
+The serving scheduler feeds it tick time, TTFT, and queue depth and
+exposes ``anomaly_recent`` in ``metrics()``; the fleet ``SLOGate`` treats
+a recently-anomalous replica as hot (spill-around), making the sentinel
+an admission signal, not just a log line.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Optional
+
+
+class StreamingDetector:
+    """One series' rolling median/MAD detector."""
+
+    def __init__(self, window: int = 64, threshold: float = 8.0,
+                 min_samples: int = 8, context: int = 8,
+                 rel_floor: float = 0.05, abs_floor: float = 1e-9):
+        if window < 4:
+            raise ValueError(f"window must be >= 4, got {window}")
+        if threshold <= 0:
+            raise ValueError(f"threshold must be > 0, got {threshold}")
+        self.window = window
+        self.threshold = float(threshold)
+        self.min_samples = max(int(min_samples), 2)
+        self.context = int(context)
+        self.rel_floor = float(rel_floor)
+        self.abs_floor = float(abs_floor)
+        self._buf: deque = deque(maxlen=window)
+        self.seen = 0
+        self.anomalies = 0
+
+    def observe(self, value: float) -> Optional[dict]:
+        """Test ``value`` against the CURRENT baseline (the spike must not
+        contaminate the window it is judged by), then absorb it. Returns
+        the anomaly record dict, or None."""
+        import numpy as np
+
+        value = float(value)
+        self.seen += 1
+        hit = None
+        if len(self._buf) >= self.min_samples:
+            buf = np.asarray(self._buf, dtype=np.float64)
+            med = float(np.median(buf))
+            mad = float(np.median(np.abs(buf - med)))
+            scale = max(
+                1.4826 * mad, self.rel_floor * abs(med), self.abs_floor
+            )
+            z = (value - med) / scale
+            if abs(z) > self.threshold:
+                self.anomalies += 1
+                hit = {
+                    "value": value,
+                    "zscore": round(z, 2),
+                    "median": med,
+                    "mad": mad,
+                    "n_baseline": int(len(buf)),
+                    "index": self.seen - 1,
+                    "context": [
+                        round(v, 9) for v in list(self._buf)[-self.context:]
+                    ],
+                }
+        self._buf.append(value)
+        return hit
+
+
+class AnomalySentinel:
+    """Named-series front end over per-series detectors.
+
+    ``observe(series, value, **meta)`` returns the anomaly record (meta
+    merged in) or None, and streams it as ``kind="anomaly"`` JSONL when a
+    ``metrics_log`` is attached (attachable after construction — the
+    trainers build the sentinel before their logger exists). An optional
+    ``flightrec`` gets one ring event per hit, so a post-mortem dump
+    shows the anomalies that preceded death."""
+
+    def __init__(self, threshold: float = 8.0, window: int = 64,
+                 min_samples: int = 8, context: int = 8,
+                 metrics_log=None, flightrec=None, source: str = ""):
+        self.threshold = float(threshold)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.context = int(context)
+        self.metrics_log = metrics_log
+        self.flightrec = flightrec
+        self.source = source
+        self._detectors: Dict[str, StreamingDetector] = {}
+        self.anomalies = 0
+
+    def detector(self, series: str) -> StreamingDetector:
+        det = self._detectors.get(series)
+        if det is None:
+            det = self._detectors[series] = StreamingDetector(
+                window=self.window, threshold=self.threshold,
+                min_samples=self.min_samples, context=self.context,
+            )
+        return det
+
+    def observe(self, series: str, value: float, **meta) -> Optional[dict]:
+        hit = self.detector(series).observe(value)
+        if hit is None:
+            return None
+        self.anomalies += 1
+        hit["series"] = series
+        if self.source:
+            hit["source"] = self.source
+        hit.update(meta)
+        if self.metrics_log is not None:
+            self.metrics_log.log(kind="anomaly", **hit)
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "anomaly", series=series, value=hit["value"],
+                zscore=hit["zscore"],
+            )
+        return hit
+
+    def counts(self) -> Dict[str, int]:
+        return {
+            name: det.anomalies for name, det in self._detectors.items()
+            if det.anomalies
+        }

@@ -1,0 +1,246 @@
+"""Per-program cost cards: FLOP/byte accounting joined with measured time.
+
+PR 4's telemetry answers *what* a run spent its wall on; this module
+answers *why* a program takes the time it takes. For every program the
+``compilecache.ProgramRegistry`` enumerates, a **cost card** records the
+compiler's own static accounting — FLOPs and bytes accessed from
+``Compiled.cost_analysis()``, argument/output/temp bytes from
+``memory_analysis()`` — and, once the run has measured wall time for the
+program (scheduler tick spans, trainer epoch timing), joins the two into
+achieved FLOP/s, achieved HBM bandwidth, MFU against the device's peak,
+and a compute-vs-bandwidth **roofline classification**: a program whose
+arithmetic intensity (FLOP/B) sits below the device ridge point
+(peak FLOP/s over peak B/s) cannot be compute-bound no matter how well it
+is scheduled — exactly the analysis PERF_NOTES.md §4/§7 did by hand for
+the ResNet step, now produced by the runtime for every program
+(generalizing the one-off ``scripts/exp_resnet_roofline.py``).
+
+Caveats, stated on the card rather than hidden:
+
+- XLA's ``bytes accessed`` double-counts fused intermediates (PERF_NOTES
+  §9 measured 40.6 GB reported vs 23.3 GB real HBM traffic), so achieved
+  GB/s derived from it is an UPPER bound on real traffic — fine for
+  *classification* (a program the metric calls bandwidth-bound is), a
+  known overestimate for absolute bandwidth.
+- Measured seconds are host wall around the dispatch (the spans the run
+  already records). Programs whose results the caller materializes
+  (decode tick, epoch-synced train steps) are honest; pure-dispatch
+  spans under-report on async backends — the card carries ``calls`` so a
+  reader can judge the join.
+
+Ceilings come from ``device_ceilings()``: env overrides
+``PDT_PEAK_FLOPS`` (FLOP/s) / ``PDT_PEAK_GBS`` (GB/s) first, then a
+small builtin table of measured numbers (the v5e entries are this repo's
+own measurements, PERF_NOTES §2/§7). Unknown device → no MFU/bound
+columns, but the card (and achieved rates) still emit: attribution
+degrades, never crashes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: (peak FLOP/s, peak bytes/s) per jax device kind. v5e compute is the
+#: bf16 datasheet peak (the MFU convention); bandwidth is the MEASURED
+#: streaming ceiling (PERF_NOTES §7: 657 GB/s triad vs 819 datasheet) —
+#: roofline fractions against what the chip actually streams.
+DEVICE_CEILINGS: Dict[str, Tuple[float, float]] = {
+    "TPU v5 lite": (197e12, 657e9),
+    "TPU v5e": (197e12, 657e9),
+    "TPU v4": (275e12, 1228e9),
+}
+
+
+def device_ceilings(device_kind: Optional[str] = None):
+    """``(peak_flops, peak_bytes_s)`` for the active device, or
+    ``(None, None)`` when unknown. Env ``PDT_PEAK_FLOPS`` /
+    ``PDT_PEAK_GBS`` override both the table and the unknown case — the
+    knob CI uses to render full roofline tables on the CPU backend."""
+    flops = os.environ.get("PDT_PEAK_FLOPS")
+    gbs = os.environ.get("PDT_PEAK_GBS")
+    if flops or gbs:
+        return (
+            float(flops) if flops else None,
+            float(gbs) * 1e9 if gbs else None,
+        )
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None, None
+    return DEVICE_CEILINGS.get(device_kind, (None, None))
+
+
+def extract_costs(compiled) -> dict:
+    """Static cost fields from a ``jax.stages.Compiled`` (or ``Lowered``).
+
+    ``cost_analysis()`` has returned both a bare dict and a per-device
+    list of dicts across jax versions — both shapes are handled. Any
+    backend that cannot produce an analysis yields an empty dict: a cost
+    card with unknown FLOPs is still a card."""
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            if ca.get("flops") is not None:
+                out["flops"] = float(ca["flops"])
+            if ca.get("bytes accessed") is not None:
+                out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg = int(getattr(ma, "argument_size_in_bytes", 0))
+            outb = int(getattr(ma, "output_size_in_bytes", 0))
+            tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+            out["argument_bytes"] = arg
+            out["output_bytes"] = outb
+            out["temp_bytes"] = tmp
+            # live working set while the program runs — the number that
+            # decides whether two programs can overlap in HBM
+            out["peak_bytes"] = arg + outb + tmp
+    except Exception:
+        pass
+    return out
+
+
+@dataclasses.dataclass
+class CostCard:
+    """One program's static cost accounting plus its measured join."""
+
+    program: str
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    peak_bytes: Optional[int] = None
+    # measured join (ProgramTimes): host wall attributed to this program
+    calls: int = 0
+    total_s: float = 0.0
+
+    @property
+    def intensity(self) -> Optional[float]:
+        """Arithmetic intensity, FLOP per byte accessed."""
+        if not self.flops or not self.bytes_accessed:
+            return None
+        return self.flops / self.bytes_accessed
+
+    def record(self, peak_flops: Optional[float] = None,
+               peak_bytes_s: Optional[float] = None) -> dict:
+        """The flat ``kind="program_cost"`` JSONL record: statics,
+        measured join, and every derived rate the ceilings allow."""
+        rec: dict = {"program": self.program, "calls": self.calls}
+        for k in ("flops", "bytes_accessed", "argument_bytes",
+                  "output_bytes", "temp_bytes", "peak_bytes"):
+            v = getattr(self, k)
+            if v is not None:
+                rec[k] = v
+        if self.intensity is not None:
+            rec["intensity_flop_b"] = round(self.intensity, 3)
+        if self.calls and self.total_s > 0:
+            mean_s = self.total_s / self.calls
+            rec["total_s"] = round(self.total_s, 6)
+            rec["mean_s"] = round(mean_s, 6)
+            if self.flops:
+                rec["achieved_flops_s"] = self.flops / mean_s
+                if peak_flops:
+                    rec["mfu"] = round(self.flops / mean_s / peak_flops, 5)
+            if self.bytes_accessed:
+                rec["achieved_bytes_s"] = self.bytes_accessed / mean_s
+                if peak_bytes_s:
+                    rec["hbm_frac"] = round(
+                        self.bytes_accessed / mean_s / peak_bytes_s, 5
+                    )
+        if peak_flops and peak_bytes_s and self.intensity is not None:
+            ridge = peak_flops / peak_bytes_s
+            rec["ridge_flop_b"] = round(ridge, 3)
+            rec["bound"] = (
+                "compute" if self.intensity >= ridge else "bandwidth"
+            )
+        return rec
+
+
+class ProgramTimes:
+    """Per-program measured wall accumulator — the join side of a cost
+    card. ``observe(name, seconds)`` adds one call;
+    ``observe_total(name, seconds, calls)`` adds a pre-aggregated window
+    (epoch timing). Thread-safe enough for the single-writer call sites
+    (scheduler tick loop, trainer epoch end)."""
+
+    def __init__(self):
+        self._acc: Dict[str, Tuple[int, float]] = {}
+
+    def observe(self, name: str, seconds: float) -> None:
+        self.observe_total(name, seconds, 1)
+
+    def observe_total(self, name: str, seconds: float, calls: int) -> None:
+        if calls < 1 or seconds < 0:
+            return
+        n, s = self._acc.get(name, (0, 0.0))
+        self._acc[name] = (n + calls, s + float(seconds))
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._acc
+
+    def get(self, name: str) -> Tuple[int, float]:
+        return self._acc.get(name, (0, 0.0))
+
+    def items(self):
+        return self._acc.items()
+
+
+def build_cost_cards(registry, times: Optional[ProgramTimes] = None,
+                     ) -> List[CostCard]:
+    """One card per registry program, in registry order.
+
+    Statics come from each spec's ``aot`` thunk (``lower(...).compile()``
+    — a persistent-cache hit when ``enable_persistent_cache`` ran, a
+    fresh XLA compile otherwise; that cost is why trainers gate card
+    emission behind ``cost_cards=True`` and pay it once at fit end, off
+    the training critical path). A spec without an ``aot`` thunk, or one
+    whose compile/analysis fails, still yields a card — with the static
+    fields unknown — so "every program in the registry has a cost card"
+    holds unconditionally."""
+    cards = []
+    for spec in registry:
+        card = CostCard(program=spec.name)
+        aot = getattr(spec, "aot", None)
+        if aot is not None:
+            try:
+                compiled = aot()
+                if compiled is not None:
+                    for k, v in extract_costs(compiled).items():
+                        setattr(card, k, v)
+            except Exception:
+                pass  # unanalyzable program: card ships without statics
+        if times is not None:
+            card.calls, card.total_s = times.get(spec.name)
+        cards.append(card)
+    return cards
+
+
+def log_cost_cards(registry, times, metrics_log, *,
+                   fingerprint: Optional[str] = None) -> List[dict]:
+    """Build every card, join, and emit one ``kind="program_cost"``
+    JSONL record per program. Returns the records (emitted or not — a
+    ``metrics_log`` of None still returns them for callers that render
+    directly)."""
+    peak_flops, peak_bytes_s = device_ceilings()
+    records = []
+    for card in build_cost_cards(registry, times):
+        rec = card.record(peak_flops, peak_bytes_s)
+        rec["fingerprint"] = (
+            fingerprint if fingerprint is not None else registry.fingerprint
+        )
+        records.append(rec)
+        if metrics_log is not None:
+            metrics_log.log(kind="program_cost", **rec)
+    return records

@@ -1,0 +1,126 @@
+"""Live metrics exporter: a stdlib-HTTP Prometheus-text ``/metrics``.
+
+The JSONL stream is a flight data recorder; operators also need a live
+gauge. This is the smallest honest version: a daemon
+``ThreadingHTTPServer`` whose ``/metrics`` renders a caller-supplied
+``collect()`` dict (the shapes the run already has —
+``Scheduler.metrics()``, ``FleetRouter.metrics()``, a trainer's goodput
+report) in Prometheus text exposition format. No dependency, no push
+gateway, no background sampling thread: ``collect()`` runs on the HTTP
+thread at scrape time, so an unscraped exporter costs nothing.
+
+Scrape-path discipline: ``collect`` callbacks must stay host-side (the
+metric dicts this repo produces are exact host counters by design —
+PR 4). Nothing here touches the device.
+
+    exporter = MetricsExporter(scheduler.metrics, port=9100).start()
+    # curl localhost:9100/metrics
+    exporter.stop()
+
+``port=0`` binds an ephemeral port (tests); ``.port`` reports the bound
+one. ``/healthz`` answers 200 while the thread lives.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+def _sanitize(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return out if not out[:1].isdigit() else f"_{out}"
+
+
+def prometheus_text(metrics: dict, prefix: str = "pdt") -> str:
+    """Flat metrics dict → Prometheus text exposition. Numbers emit as
+    gauges (bools as 0/1); non-numeric values are skipped — the format
+    has no string type and a label-less gauge is the honest mapping for
+    the flat dicts this repo produces."""
+    lines = []
+    for key in sorted(metrics):
+        value = metrics[key]
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            continue
+        if value != value or value in (float("inf"), float("-inf")):
+            continue  # NaN/inf serialize poorly across scrapers
+        name = f"{_sanitize(prefix)}_{_sanitize(key)}"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """Serve ``collect()`` as Prometheus text on ``/metrics``."""
+
+    def __init__(self, collect: Callable[[], dict], port: int = 0,
+                 host: str = "127.0.0.1", prefix: str = "pdt"):
+        self.collect = collect
+        self.prefix = prefix
+        self._host = host
+        self._requested_port = int(port)
+        self.port: Optional[int] = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsExporter":
+        if self._server is not None:
+            return self
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path == "/healthz":
+                    body = b"ok\n"
+                    ctype = "text/plain"
+                elif self.path in ("/metrics", "/"):
+                    try:
+                        body = prometheus_text(
+                            exporter.collect(), exporter.prefix
+                        ).encode()
+                    except Exception as e:
+                        self.send_error(500, f"collect failed: {e}")
+                        return
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler
+        )
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="pdt-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None
+
+    def __enter__(self) -> "MetricsExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

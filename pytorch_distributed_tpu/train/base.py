@@ -17,6 +17,7 @@ Subclass contract:
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import numpy as np
@@ -29,8 +30,11 @@ from pytorch_distributed_tpu.resilience.stepguard import (
 )
 from pytorch_distributed_tpu.resilience.watchdog import Watchdog
 from pytorch_distributed_tpu.telemetry import (
+    NULL_RECORDER,
     NULL_TRACER,
+    AnomalySentinel,
     GoodputLedger,
+    ProgramTimes,
     SpanTracer,
 )
 from pytorch_distributed_tpu.utils.logging import rank0_print
@@ -48,6 +52,12 @@ class SuspendableTrainer:
     tracer = NULL_TRACER
     _ring = None
     _dispatched = 0
+    # attribution & forensics (ISSUE 8); _init_resilience overrides
+    sentinel = None
+    flightrec = NULL_RECORDER
+    exporter = None
+    prog_times = None
+    _last_step_t = None
 
     # ---- resilience plumbing (resilience/: stepguard, watchdog, faults).
     # Both trainers call _init_resilience from __init__ and bracket each
@@ -59,7 +69,12 @@ class SuspendableTrainer:
         guard exists whenever the compiled step emits ``step_good``
         (``nan_guard=True``); ``max_bad_steps=0`` means skip-only, no
         rollback. The goodput ledger and span tracer (telemetry/) are
-        built here too — the watchdog feeds the ledger its stall time."""
+        built here too — the watchdog feeds the ledger its stall time —
+        plus (ISSUE 8) the anomaly sentinel, flight recorder, and
+        per-program time accumulator; the metrics JSONL is created after
+        this runs, so the trainers bind it via ``_bind_observability``."""
+        from pytorch_distributed_tpu.telemetry import FlightRecorder
+
         cfg = self.config
         self.goodput = GoodputLedger()
         self.tracer = (
@@ -67,6 +82,39 @@ class SuspendableTrainer:
         )
         self._ring = None  # built lazily from the first metrics dict
         self._dispatched = 0  # run-level step-dispatch count (compile attr)
+        self.prog_times = ProgramTimes()
+        self._last_step_t = None
+        threshold = getattr(cfg, "anomaly_threshold", 8.0)
+        self.sentinel = (
+            AnomalySentinel(
+                threshold=threshold,
+                window=getattr(cfg, "anomaly_window", 64),
+            )
+            if threshold and threshold > 0 else None
+        )
+        if self.sentinel is not None:
+            # 10 ms scale floor: near-constant tiny-step series would
+            # otherwise flag scheduler jitter (MAD ≈ 0 → any blip is ∞σ);
+            # a stall must clear threshold × 10 ms above the baseline
+            self.sentinel.detector("step_time").abs_floor = 0.01
+            self.sentinel.detector("data_wait").abs_floor = 0.01
+        rank0 = jax.process_index() == 0
+        if getattr(cfg, "flightrec", True):
+            self.flightrec = FlightRecorder(
+                capacity=256,
+                # durable per-event mirror (size-capped, rank 0): what a
+                # SIGKILL'd run leaves behind for the relaunch to read
+                mirror_path=os.path.join(cfg.save_dir, "flightrec.jsonl")
+                if rank0 else None,
+            )
+            if rank0:
+                self.flightrec.install_excepthook(
+                    os.path.join(cfg.save_dir, "flightrec_dump.json")
+                )
+            if self.sentinel is not None:
+                self.sentinel.flightrec = self.flightrec
+        else:
+            self.flightrec = NULL_RECORDER
         if getattr(cfg, "nan_guard", False):
             self.guard = StepGuard(
                 max_bad_steps=getattr(cfg, "max_bad_steps", 0)
@@ -77,10 +125,40 @@ class SuspendableTrainer:
                 timeout,
                 watcher=self.watcher,
                 dump_path=os.path.join(cfg.save_dir, "watchdog_stall.log")
-                if jax.process_index() == 0
+                if rank0
                 else None,
                 ledger=self.goodput,
+                flightrec=self.flightrec,
+                flightrec_path=os.path.join(
+                    cfg.save_dir, "flightrec_stall.json"
+                ) if rank0 else None,
             ).start()
+
+    def _bind_observability(self) -> None:
+        """Called by the trainers once ``self.metrics_log`` exists:
+        attach the sentinel's JSONL stream and start the live Prometheus
+        exporter when the config asks for one (``metrics_port``)."""
+        if self.sentinel is not None:
+            self.sentinel.metrics_log = getattr(self, "metrics_log", None)
+        port = getattr(self.config, "metrics_port", None)
+        if port is not None and jax.process_index() == 0:
+            from pytorch_distributed_tpu.telemetry import MetricsExporter
+
+            self.exporter = MetricsExporter(
+                self._live_metrics, port=port
+            ).start()
+
+    def _live_metrics(self) -> dict:
+        """The exporter's scrape callback: run-level host counters only
+        (no device sync on the scrape path)."""
+        out = dict(self.goodput.report()) if self.goodput else {}
+        out["steps_dispatched"] = self._dispatched
+        out["rollbacks"] = self.rollbacks
+        if self.sentinel is not None:
+            out["anomalies"] = self.sentinel.anomalies
+        if self.watchdog is not None:
+            out["watchdog_stalls"] = self.watchdog.stalls
+        return out
 
     # ---- compile-cache plumbing (compilecache/: registry, AOT, warmup;
     # ANALYSIS.md "Cold start & compile cache"). Both trainers call
@@ -138,9 +216,16 @@ class SuspendableTrainer:
                 for avals in thunk():
                     fn.lower(*avals).compile()
 
+            def aot(fn=fn, thunk=avals_thunk):
+                # cost-card statics from the steady-state (first) aval
+                # variant; a multi-shape eval step's card covers shape 0
+                avals = thunk()
+                return fn.lower(*avals[0]).compile() if avals else None
+
             reg.add(ProgramSpec(
                 name=name, warm=warm, priority=0, expect_entries=expect,
                 cache_probe=lambda fn=fn: jit_cache_size(fn),
+                aot=aot,
             ))
         return reg
 
@@ -217,6 +302,25 @@ class SuspendableTrainer:
         if self.goodput is not None and getattr(self, "metrics_log", None):
             self.metrics_log.log(kind="goodput", **self.goodput.report())
 
+    def _log_cost_cards(self) -> None:
+        """Emit one ``kind="program_cost"`` record per registry program
+        (telemetry.costmodel), joining the compiler's FLOP/byte statics
+        with the run's measured per-step wall. Gated behind
+        ``config.cost_cards`` because the statics cost one extra
+        ``lower(...).compile()`` per program (a disk hit when the
+        persistent compile cache is on) — paid once at fit END, off the
+        training critical path, and never on the pre-suspend fast path."""
+        if not getattr(self.config, "cost_cards", False):
+            return
+        if jax.process_index() != 0:
+            return
+        from pytorch_distributed_tpu.telemetry import log_cost_cards
+
+        log_cost_cards(
+            self.program_registry(), self.prog_times,
+            getattr(self, "metrics_log", None),
+        )
+
     def _save_traces(self) -> None:
         """Write the span tracer's Chrome trace (rank 0, fit end)."""
         trace_dir = getattr(self.config, "trace_dir", None)
@@ -247,11 +351,35 @@ class SuspendableTrainer:
         compile outside the armed deadline window) and feed the guard its
         lagged ``step_good`` flag. The guard raises RollbackRequested
         (caught in fit) after K consecutive bad steps — deterministically
-        on every rank, since the flag is a replicated psum'd metric."""
+        on every rank, since the flag is a replicated psum'd metric.
+
+        Forensics (ISSUE 8): the step lands one flight-recorder event
+        (the ring's heartbeat — a post-mortem dump shows exactly which
+        step the run died after) and its wall gap feeds the anomaly
+        sentinel's ``step_time`` series. The gap is post_step→post_step,
+        so a hang anywhere in the loop (data fetch, injected fault,
+        dispatch) shows up; the first gap of a run (compile) is absorbed
+        by the detector's warmup window."""
         if self.watchdog is not None:
             self.watchdog.beat()
         if self.guard is not None:
             self.guard.observe(metrics.get("step_good"))
+        now = time.perf_counter()
+        self.flightrec.record("step", n=self._dispatched)
+        if self._last_step_t is not None and self.sentinel is not None:
+            self.sentinel.observe(
+                "step_time", now - self._last_step_t,
+                step=self._dispatched,
+            )
+        self._last_step_t = now
+
+    def _observe_data_wait(self, seconds: float) -> None:
+        """Per-step data-wait observation for the sentinel (the trainers
+        call this from their ``data_wait`` bracket)."""
+        if self.sentinel is not None:
+            self.sentinel.observe(
+                "data_wait", seconds, step=self._dispatched
+            )
 
     def _epoch_end_guard(self) -> None:
         if self.guard is not None:
@@ -265,6 +393,14 @@ class SuspendableTrainer:
         a state the guard condemned would just NaN again."""
         self.rollbacks += 1
         rank0_print(f"stepguard: {err}; restoring last good checkpoint")
+        # forensics: the condemned run's last events, dumped before the
+        # replay overwrites the ring's recent history
+        self.flightrec.record("rollback", n=self.rollbacks, reason=str(err))
+        if jax.process_index() == 0:
+            self.flightrec.dump(
+                os.path.join(self.config.save_dir, "flightrec_dump.json"),
+                "rollback",
+            )
         # surface the condemned run's buffered log events before the
         # replay re-logs the same steps (keeps the JSONL ordered)
         self._drain_train_records(self._telemetry_flush())
@@ -409,6 +545,7 @@ class SuspendableTrainer:
         every = getattr(self.config, "save_every_n_steps", 0)
         if every <= 0 or (step + 1) % every:  # negative = off, like 0
             return
+        self.flightrec.record("ckpt_save", epoch=epoch, step=step)
         with self.goodput.timed("checkpoint"), \
                 self.tracer.span("ckpt_save", step=step):
             gstep = int(np.asarray(jax.device_get(self.state.step)))
@@ -442,6 +579,14 @@ class SuspendableTrainer:
             )
         if not suspended:
             return
+        # forensics first: the pre-suspend ring is the record of WHY the
+        # run yielded (watchdog latch vs scheduler signal)
+        self.flightrec.record("suspend", epoch=epoch, step=step)
+        if jax.process_index() == 0:
+            self.flightrec.dump(
+                os.path.join(self.config.save_dir, "flightrec_dump.json"),
+                "suspend",
+            )
         # the run is about to yield: surface the ring's buffered log
         # events so the JSONL tail isn't lost with the process
         self._drain_train_records(self._telemetry_flush())

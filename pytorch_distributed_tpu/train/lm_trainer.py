@@ -162,6 +162,15 @@ class LMTrainerConfig:
     # rule tables, optimizer moments included); False = same-topology
     # restores only.
     elastic_resume: bool = True
+    # Attribution & forensics — see TrainerConfig: anomaly sentinel over
+    # step-time/data-wait (robust z, 0 = off), flight-recorder ring +
+    # mirror + trigger dumps, fit-end per-program cost cards, live
+    # Prometheus /metrics port.
+    anomaly_threshold: float = 8.0
+    anomaly_window: int = 64
+    flightrec: bool = True
+    cost_cards: bool = False
+    metrics_port: Optional[int] = None
 
 
 class LMTrainer(SuspendableTrainer):
@@ -352,6 +361,7 @@ class LMTrainer(SuspendableTrainer):
             config.metrics_out
             or os.path.join(config.save_dir, "metrics.jsonl")
         )
+        self._bind_observability()  # sentinel JSONL + live exporter
 
     # ---- program registry (compilecache/): the programs this trainer
     # compiles, with the batch avals the loader will actually produce ----
@@ -437,9 +447,11 @@ class LMTrainer(SuspendableTrainer):
             self.train_loader.iter_batches(start_step), start=start_step
         )
         while True:
+            t_wait = time.perf_counter()
             with self.goodput.timed("data_wait"), \
                     self.tracer.span("data_wait"):
                 pair = next(it, None)
+            self._observe_data_wait(time.perf_counter() - t_wait)
             if pair is None:
                 break
             step, host_batch = pair
@@ -484,6 +496,10 @@ class LMTrainer(SuspendableTrainer):
         if steps_done:
             float(self.state.step)  # drain async dispatch before the clock
             elapsed = time.perf_counter() - t0
+            # cost-card join: epoch wall attributed to the step program
+            self.prog_times.observe_total(
+                "lm_train_step", elapsed, steps_done
+            )
             record = {
                 "kind": "epoch_timing", "epoch": epoch, "steps": steps_done,
                 "mean_ms": 1e3 * elapsed / steps_done,
@@ -592,8 +608,11 @@ class LMTrainer(SuspendableTrainer):
             self.ckpt.wait()  # commit any pending best-save before return
         if self.watchdog is not None:
             self.watchdog.stop()
+        self._log_cost_cards()  # per-program MFU/roofline attribution
         self._log_goodput()
         self._save_traces()
+        if self.exporter is not None:
+            self.exporter.stop()
         self.start_step = 0
         summary["best_ppl"] = self.best_ppl
         return summary

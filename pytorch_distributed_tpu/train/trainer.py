@@ -121,6 +121,20 @@ class TrainerConfig:
     # back any topology. False refuses topology-mismatched candidates
     # (they fall through to older same-topology checkpoints).
     elastic_resume: bool = True
+    # Attribution & forensics (telemetry/, ANALYSIS.md "Performance
+    # attribution & forensics"): anomaly_threshold is the sentinel's
+    # robust z-score bound over the step-time/data-wait series (0 = off;
+    # MAD-based, immune to the first-step compile); flightrec keeps a
+    # bounded ring of recent events mirrored to <save_dir>/flightrec.jsonl
+    # and dumped atomically on stall/rollback/suspend/exception;
+    # cost_cards emits kind="program_cost" records at fit end (one extra
+    # AOT compile per program — a cache hit when compile_cache_dir is
+    # set); metrics_port serves live Prometheus-text /metrics.
+    anomaly_threshold: float = 8.0
+    anomaly_window: int = 64
+    flightrec: bool = True
+    cost_cards: bool = False
+    metrics_port: Optional[int] = None
 
 
 class Trainer(SuspendableTrainer):
@@ -238,6 +252,7 @@ class Trainer(SuspendableTrainer):
             config.metrics_out
             or os.path.join(config.save_dir, "metrics.jsonl")
         )
+        self._bind_observability()  # sentinel JSONL + live exporter
 
     # ---- program registry (compilecache/): the programs this trainer
     # compiles, with the batch avals the loaders will actually produce ----
@@ -348,9 +363,11 @@ class Trainer(SuspendableTrainer):
             self.train_loader.iter_batches(start_step), start=start_step
         )
         while True:
+            t_wait = time.perf_counter()
             with self.goodput.timed("data_wait"), \
                     self.tracer.span("data_wait"):
                 pair = next(it, None)
+            self._observe_data_wait(time.perf_counter() - t_wait)
             if pair is None:
                 break
             step, host_batch = pair
@@ -394,6 +411,9 @@ class Trainer(SuspendableTrainer):
             # same caveat as the reference's epoch timing).
             float(self.state.step)
             elapsed = time.perf_counter() - t0
+            # cost-card join: this epoch's synced wall attributed to the
+            # train step program (telemetry/costmodel.py)
+            self.prog_times.observe_total("train_step", elapsed, steps_done)
             self.metrics_log.log(
                 kind="epoch_timing", epoch=epoch, steps=steps_done,
                 mean_ms=1e3 * elapsed / steps_done,
@@ -497,8 +517,11 @@ class Trainer(SuspendableTrainer):
             self.ckpt.wait()  # commit any pending best-save before return
         if self.watchdog is not None:
             self.watchdog.stop()
+        self._log_cost_cards()  # per-program MFU/roofline attribution
         self._log_goodput()
         self._save_traces()
+        if self.exporter is not None:
+            self.exporter.stop()
         self.start_step = 0
         summary["best_acc"] = self.best_acc
         return summary

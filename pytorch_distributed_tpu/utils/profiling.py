@@ -229,10 +229,20 @@ class MetricsLogger:
     a path APPENDS (mode "a" — a resumed run extends its history), and
     the logger is a context manager. Line-buffered writes: every record
     is durable as soon as ``log`` returns.
+
+    Rotation (ISSUE 8): ``max_bytes`` caps the stream for long runs —
+    once the active file passes the cap it rotates to ``<path>.1``
+    (replacing the previous generation) and a fresh file continues, so
+    total disk stays bounded by ~2×``max_bytes`` while the newest
+    history is always intact. Rotation is record-aligned (checked after
+    a complete line), so neither generation ever holds a torn record.
     """
 
-    def __init__(self, path: Optional[str], rank0_only: bool = True):
+    def __init__(self, path: Optional[str], rank0_only: bool = True,
+                 max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = max_bytes
+        self.rotations = 0
         self._f = None
         if path and (not rank0_only or self._is_rank0()):
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -253,6 +263,19 @@ class MetricsLogger:
             return
         record.setdefault("ts", time.time())
         self._f.write(json.dumps(record) + "\n")
+        if self.max_bytes is not None and self._f.tell() >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        """Roll the full active file to ``<path>.1`` (one kept
+        generation) and continue on a fresh one."""
+        self._f.close()
+        try:
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            pass  # a racing cleanup removed it: just reopen fresh
+        self._f = open(self.path, "a", buffering=1)
+        self.rotations += 1
 
     def close(self) -> None:
         if self._f is not None:

@@ -118,6 +118,28 @@ def _base_parser(description: str, save_dir: str,
                         "--compile-cache-dir the goodput compile fraction "
                         "collapses; kind=\"warmup\" manifest records land "
                         "in the metrics JSONL")
+    # Attribution & forensics (telemetry/; ANALYSIS.md "Performance
+    # attribution & forensics"). Example — flag a wedged step and leave a
+    # readable event ring behind:
+    #   PDT_FAULT_PLAN='{"faults":[{"site":"train.step","kind":"hang",
+    #       "at":10,"seconds":2}]}' python recipes/lm_pretrain.py --tiny \
+    #       --metrics-out run.jsonl --cost-cards
+    #   python scripts/telemetry_report.py run.jsonl   # anomaly + roofline
+    p.add_argument("--cost-cards", action="store_true",
+                   help="emit kind=\"program_cost\" records at fit end: "
+                        "per-program FLOPs/bytes from the compiler joined "
+                        "with measured step time into MFU and a "
+                        "compute-vs-bandwidth roofline class (one extra "
+                        "AOT compile per program, cache-hit when "
+                        "--compile-cache-dir is set)")
+    p.add_argument("--anomaly-threshold", type=float, default=8.0,
+                   help="robust z-score bound for the streaming anomaly "
+                        "sentinel over step-time/data-wait series "
+                        "(kind=\"anomaly\" JSONL with context; 0 = off)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve live Prometheus-text /metrics on this "
+                        "port (stdlib HTTP thread; 0 = ephemeral); "
+                        "scripts/pdt_top.py is the JSONL-tailing twin")
     return p
 
 
@@ -210,6 +232,9 @@ def run(args, mesh, precision: str = "fp32") -> dict:
         flush_every=args.flush_every,
         compile_cache_dir=args.compile_cache_dir,
         warmup=args.warmup,
+        cost_cards=args.cost_cards,
+        anomaly_threshold=args.anomaly_threshold,
+        metrics_port=args.metrics_port,
     )
     trainer = Trainer(
         model,

@@ -185,6 +185,9 @@ def main() -> None:
         flush_every=args.flush_every,
         compile_cache_dir=args.compile_cache_dir,
         warmup=args.warmup,
+        cost_cards=args.cost_cards,
+        anomaly_threshold=args.anomaly_threshold,
+        metrics_port=args.metrics_port,
     )
     trainer = LMTrainer(model_cfg, train_ds, val_ds, cfg, mesh=mesh,
                         suspend_watcher=SuspendWatcher())

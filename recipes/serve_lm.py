@@ -155,6 +155,19 @@ def _parse() -> argparse.Namespace:
                    help="replay a traffic trace (bench_serving.py "
                         "--gen-trace) instead of submitting the "
                         "synthetic workload all at once")
+    # Attribution & forensics (telemetry/; ANALYSIS.md "Performance
+    # attribution & forensics")
+    p.add_argument("--cost-cards", action="store_true",
+                   help="after the serve cycle, emit one "
+                        "kind=\"program_cost\" record per registry "
+                        "program (compiler FLOPs/bytes joined with "
+                        "measured tick wall → MFU/roofline; "
+                        "telemetry_report.py renders the table). "
+                        "AOT-compiles every not-yet-compiled bucket "
+                        "once, after traffic; paged layout only")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve live Prometheus-text /metrics while the "
+                        "cycle runs (stdlib HTTP thread)")
     return p.parse_args()
 
 
@@ -214,6 +227,11 @@ def main() -> None:
     mlog = MetricsLogger(args.metrics_out)
     t0 = time.perf_counter()
     fleet_mode = args.replicas > 1 or args.disaggregate or args.trace
+    if args.dense and (args.cost_cards or args.metrics_port is not None):
+        raise SystemExit("--cost-cards/--metrics-port need the paged "
+                         "layout (program registry + scheduler metrics); "
+                         "drop --dense")
+    exporter = None
     if fleet_mode and args.dense:
         raise SystemExit("--replicas/--disaggregate/--trace need the "
                          "paged layout; drop --dense")
@@ -245,6 +263,13 @@ def main() -> None:
         )
         if args.warmup:
             router.warmup()
+        if args.metrics_port is not None:
+            from pytorch_distributed_tpu.telemetry import MetricsExporter
+
+            exporter = MetricsExporter(
+                router.metrics, port=args.metrics_port
+            ).start()
+            rank0_print(f"metrics: http://127.0.0.1:{exporter.port}/metrics")
         if args.trace:
             trace = clamp_trace(
                 load_trace(args.trace), cfg.max_seq_len,
@@ -263,6 +288,11 @@ def main() -> None:
             router.drain()
         metrics = {"layout": "fleet", **router.metrics()}
         router.log_summary()
+        if args.cost_cards:
+            for rep in router.replicas:
+                rep.log_cost_cards()
+        if exporter is not None:
+            exporter.stop()
         metrics["wall_s"] = round(time.perf_counter() - t0, 2)
         mlog.close()
         if args.trace_dir:
@@ -308,10 +338,21 @@ def main() -> None:
                 f"warmup: {ws['programs']} programs in "
                 f"{ws['total_s']:.2f}s ({ws['cache_hits']} cache hits)"
             )
+        if args.metrics_port is not None:
+            from pytorch_distributed_tpu.telemetry import MetricsExporter
+
+            exporter = MetricsExporter(
+                s.metrics, port=args.metrics_port
+            ).start()
+            rank0_print(f"metrics: http://127.0.0.1:{exporter.port}/metrics")
         for p in prompts:
             s.submit(p, args.max_new)
         streams = s.drain()
         metrics = {"layout": "paged", **s.metrics()}
+        if args.cost_cards:
+            s.log_cost_cards()
+        if exporter is not None:
+            exporter.stop()
         assert len(streams) == args.requests
     metrics["wall_s"] = round(time.perf_counter() - t0, 2)
     mlog.log(kind="serving_summary", **metrics)

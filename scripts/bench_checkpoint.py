@@ -11,6 +11,19 @@ measured here dominates. Emits one JSON line:
   {"ckpt_params_m": ..., "ckpt_bytes_mb": ..., "ckpt_save_s": ...,
    "ckpt_restore_s": ..., "ckpt_mb_per_s": ...}
 
+Restore rows are LABELED cold vs warm (ISSUE 8, reconciling ADVICE §4's
+r4 0.59 s vs r5 11.99 s): ``ckpt_restore_warm_s`` is the median of N
+page-cache-warm restores (the bytes were just written — a memcpy, not a
+disk read), ``ckpt_restore_cold_s`` restores after evicting the
+checkpoint's pages (``posix_fadvise DONTNEED``, no root needed) so it
+pays the real disk read, and both are co-quoted with same-minute disk
+probes (``ckpt_disk_mb_s`` write, ``ckpt_disk_read_mb_s`` cold read)
+plus the read-bound floor ``ckpt_restore_disk_bound_s`` — so a restore
+number is interpretable as efficiency-vs-disk instead of a
+page-cache-state lottery. ``ckpt_restore_s`` keeps its historical
+meaning (first restore right after save ≈ warm) for series continuity;
+see PERF_NOTES §10.
+
 ``--reshard`` appends the elastic-restore section (reshard/, ROADMAP
 item 4): the same dp4xtp2+FSDP checkpoint restored onto its own mesh
 (exact-block fast path) vs onto (2,1,2) and (8,1,1) (cross-topology
@@ -46,6 +59,33 @@ jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
+
+
+def _evict_page_cache(path: str) -> bool:
+    """Best-effort eviction of ``path``'s files from the page cache:
+    fsync any dirty pages, then ``posix_fadvise(DONTNEED)`` — works on
+    our own files without root (DONTNEED drops only clean pages, hence
+    the fsync first). Returns False when the platform has no fadvise, so
+    the cold row can be labeled honestly instead of silently warm."""
+    if not hasattr(os, "posix_fadvise"):
+        return False
+    paths = []
+    if os.path.isdir(path):
+        for root, _dirs, files in os.walk(path):
+            paths += [os.path.join(root, f) for f in files]
+    else:
+        paths = [path]
+    for p in paths:
+        try:
+            fd = os.open(p, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+                os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+            finally:
+                os.close(fd)
+        except OSError:
+            return False
+    return True
 
 
 def main() -> None:
@@ -102,11 +142,49 @@ def main() -> None:
         save_sharded(os.path.join(d, "latest.ckpt"), payload)
         save_s = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
-        back = load_sharded(os.path.join(d, "latest.ckpt"), payload)
-        # touch a leaf so lazy work can't hide
-        float(np.asarray(jax.tree.leaves(back["state"].params)[0]).ravel()[0])
-        restore_s = time.perf_counter() - t0
+        ckpt_path = os.path.join(d, "latest.ckpt")
+
+        def timed_restore():
+            t0 = time.perf_counter()
+            back = load_sharded(ckpt_path, payload)
+            # touch a leaf so lazy work can't hide
+            float(np.asarray(
+                jax.tree.leaves(back["state"].params)[0]
+            ).ravel()[0])
+            return time.perf_counter() - t0
+
+        # historical row (r1-r5 series continuity): the first restore
+        # right after save — page-cache WARM unless the box evicted the
+        # bytes between save and restore, which is exactly the r4 0.59 s
+        # vs r5 11.99 s ambiguity the labeled rows below resolve
+        restore_s = timed_restore()
+
+        # labeled WARM: median-of-3 cache-hot restores (a memcpy rate)
+        warm_restores = [timed_restore() for _ in range(3)]
+
+        # same-minute cold disk READ probe: evict the probe's own pages,
+        # read it back — the r/w twin of the write probe above
+        probe2 = np.ones(probe_mb * 2**20, np.uint8)
+        pp = os.path.join(d, "disk_probe_read.bin")
+        with open(pp, "wb") as f:
+            f.write(memoryview(probe2))
+            f.flush()
+            os.fsync(f.fileno())
+        del probe2
+        disk_read_mb_s = None
+        if _evict_page_cache(pp):
+            t0 = time.perf_counter()
+            with open(pp, "rb") as f:
+                while f.read(32 * 2**20):
+                    pass
+            disk_read_mb_s = probe_mb / (time.perf_counter() - t0)
+        os.remove(pp)
+
+        # labeled COLD: evict the checkpoint's pages, restore once —
+        # the relaunch-after-preemption number, disk-read bound
+        cold_restore_s = (
+            timed_restore() if _evict_page_cache(ckpt_path) else None
+        )
 
         # the non-stalling trainer path: the step loop pays ONLY the
         # device→host snapshot; write rides a thread, commit lands at the
@@ -151,6 +229,15 @@ def main() -> None:
         "ckpt_save_s": round(save_s, 2),
         "ckpt_save_disk_bound_s": round(total_bytes / 2**20 / disk_mb_s, 2),
         "ckpt_restore_s": round(restore_s, 2),
+        "ckpt_restore_warm_s": round(float(np.median(warm_restores)), 2),
+        "ckpt_restore_warm_min_s": round(min(warm_restores), 2),
+        "ckpt_restore_warm_max_s": round(max(warm_restores), 2),
+        **({"ckpt_restore_cold_s": round(cold_restore_s, 2)}
+           if cold_restore_s is not None else {}),
+        **({"ckpt_disk_read_mb_s": round(disk_read_mb_s, 1),
+            "ckpt_restore_disk_bound_s": round(
+                total_bytes / 2**20 / disk_read_mb_s, 2)}
+           if disk_read_mb_s else {}),
         "ckpt_arena_warm_bg_s": round(warm_s, 2),
         "ckpt_stall_first_s": round(stall_first_s, 2),
         "ckpt_stall_s": round(float(np.median(stalls)), 2),

@@ -620,6 +620,46 @@ def measure_tp_virtual(slots: int = 8, tp: int = 2) -> dict:
     }
 
 
+def link_probe(mb: int = 16, reps: int = 5) -> dict:
+    """Same-run bandwidth/link probe, co-quoted with every serving bench
+    row (ISSUE 8, ADVICE §6 — the ckpt bench's same-minute disk-probe
+    pattern applied to serving): cross-day serving swings on a tunneled
+    runtime track the LINK and the shared host, not the engine, so each
+    row carries the medium it was measured through.
+
+    Three rates, median of ``reps``: host memcpy (the shared-box
+    contention proxy — the round-5 stall transients were pure user-time
+    memcpy slowdowns), host→device put, and device→host get of the same
+    buffer (the ~24 MB/s tunnel hazard PERF_NOTES §1 documents)."""
+    import numpy as np
+
+    buf = np.ones(mb * 2**20, np.uint8)
+
+    def med(f):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    host_s = med(lambda: buf.copy())
+    dev = None
+
+    def h2d():
+        nonlocal dev
+        dev = jax.block_until_ready(jax.device_put(buf))
+
+    h2d_s = med(h2d)
+    d2h_s = med(lambda: np.asarray(jax.device_get(dev)))
+    return {
+        "probe_mb": mb,
+        "probe_host_memcpy_mb_s": round(mb / host_s, 1),
+        "probe_h2d_mb_s": round(mb / h2d_s, 1),
+        "probe_d2h_mb_s": round(mb / d2h_s, 1),
+    }
+
+
 def _argval(flag: str, default, cast=float):
     if flag in sys.argv:
         return cast(sys.argv[sys.argv.index(flag) + 1])
@@ -664,28 +704,34 @@ def main() -> None:
         save_trace(path, trace, **kw)
         print(json.dumps({"trace_path": path, "requests": len(trace), **kw}))
         return
+    # same-run link probe co-quoted with every measured row (ADVICE §6):
+    # a cross-day swing in any serving number below is attributable —
+    # either the probes moved with it (environment weather) or they
+    # didn't (a real engine change)
+    probe = link_probe()
     if "--fleet" in sys.argv:
-        print(json.dumps(measure_fleet(
+        print(json.dumps({**measure_fleet(
             trace=_cli_trace(),
             slo_ttft_ticks=_argval("--slo-ttft-ticks", None),
-        )))
+        ), **probe}))
         return
     if "--disagg" in sys.argv:
-        print(json.dumps(measure_disagg(trace=_cli_trace())))
+        print(json.dumps({**measure_disagg(trace=_cli_trace()), **probe}))
         return
     if "--stall" in sys.argv:
-        print(json.dumps(measure_admission_stall(slots)))
+        print(json.dumps({**measure_admission_stall(slots), **probe}))
         return
     if "--paged-stall" in sys.argv:
-        print(json.dumps(measure_paged_admission(slots)))
+        print(json.dumps({**measure_paged_admission(slots), **probe}))
         return
     if "--paged-latency" in sys.argv:
-        print(json.dumps(measure_paged_latency(trace=_cli_trace())))
+        print(json.dumps({**measure_paged_latency(trace=_cli_trace()),
+                          **probe}))
         return
     if "--tp-virtual" in sys.argv:
-        print(json.dumps(measure_tp_virtual()))
+        print(json.dumps({**measure_tp_virtual(), **probe}))
         return
-    print(json.dumps(measure(slots)))
+    print(json.dumps({**measure(slots), **probe}))
 
 
 if __name__ == "__main__":

@@ -7,7 +7,7 @@
 #
 # Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke|--serving-smoke|
 #                             --telemetry-smoke|--warmup-smoke|--reshard-smoke|
-#                             --fleet-smoke]
+#                             --fleet-smoke|--obs-smoke|--bench-regression]
 #
 # --resilience-smoke: lint, then ONE crash-recovery cycle from the
 # kill-matrix (SIGKILL mid-shard-write → relaunch → assert resume) —
@@ -39,6 +39,19 @@
 # the fleet section (per-replica percentiles, shed/spill rates) from
 # their JSONLs — the cheap end-to-end proof the fleet layer still
 # routes, hands off, and reports (~15 s).
+#
+# --obs-smoke: lint, then the round-11 attribution/forensics cycle: one
+# tiny LM run with a seeded train.step HANG (the sentinel must flag it)
+# and --cost-cards, a second tiny LM run with a seeded SUSPEND (the
+# flight recorder must leave an atomic dump), and one serve cycle with
+# --cost-cards — then telemetry_report.py must render the per-program
+# MFU/roofline table and >=1 anomaly (--require cost,anomaly) and the
+# flight-recorder dump must parse (~30 s).
+#
+# --bench-regression: lint, then compare the two newest BENCH_r0N.json
+# rounds key-by-key with per-key noise bands (scripts/bench_regression.py
+# --auto); exits non-zero on any regression outside its band. Optional —
+# run it when a new BENCH round lands.
 #
 # --warmup-smoke: lint, then the compile-cache round trip: prewarm a tiny
 # LM serving registry into a fresh cache (scripts/warmup.py), re-run the
@@ -113,6 +126,50 @@ if [[ "${1:-}" == "--warmup-smoke" ]]; then
         --json "$smoke/coldstart.json"
     JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
         "$smoke/cc/warmup_manifest.jsonl" --json --require warmup
+    exit 0
+fi
+
+if [[ "${1:-}" == "--bench-regression" ]]; then
+    echo "== bench regression (newest round vs previous, noise-banded) =="
+    python scripts/bench_regression.py --auto --json
+    exit 0
+fi
+
+if [[ "${1:-}" == "--obs-smoke" ]]; then
+    echo "== observability smoke (hang -> anomaly; suspend -> dump; cost cards) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    # CPU has no builtin roofline ceilings; pin synthetic ones so the
+    # report's MFU/bound columns render (the numbers gate presence, not
+    # magnitude)
+    export PDT_PEAK_FLOPS=1e12 PDT_PEAK_GBS=100
+    # run A: seeded hang at step 12 of 16 (--batch-size 1 -> 16 steps,
+    # past the sentinel's warmup window) -> kind="anomaly"; fit-end cost
+    # cards
+    JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        PDT_FAULT_PLAN='{"faults":[{"site":"train.step","kind":"hang","at":12,"seconds":1.0}]}' \
+        python recipes/lm_pretrain.py --tiny --epochs 1 --batch-size 1 \
+        --save-dir "$smoke/lm" --metrics-out "$smoke/lm.jsonl" --cost-cards
+    # run B: seeded suspend -> checkpoint-then-yield leaves the atomic
+    # flight-recorder dump (exit 0 via the suspend path)
+    JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        PDT_FAULT_PLAN='{"faults":[{"site":"train.step","kind":"suspend","at":4}]}' \
+        python recipes/lm_pretrain.py --tiny --epochs 1 \
+        --save-dir "$smoke/lm2" --metrics-out "$smoke/lm2.jsonl" || true
+    python - "$smoke/lm2/flightrec_dump.json" <<'PY'
+import json, sys
+dump = json.load(open(sys.argv[1]))
+assert dump["reason"] == "suspend" and dump["events"], dump.get("reason")
+print(f"flight recorder: {len(dump['events'])} events, reason={dump['reason']}")
+PY
+    # serve cycle with cost cards
+    JAX_PLATFORMS=cpu python recipes/serve_lm.py --tiny --requests 6 \
+        --slots 4 --max-new 8 --metrics-out "$smoke/serve.jsonl" --cost-cards
+    # the gate: roofline table + >=1 anomaly, from the JSONLs alone
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/lm.jsonl" "$smoke/serve.jsonl" --json --require cost,anomaly
     exit 0
 fi
 

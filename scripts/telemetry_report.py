@@ -18,7 +18,15 @@ produces, from the JSONL alone:
   reasons), spill rate (requests routed off their affinity replica),
   and handoff counts, from the same ``kind="request"`` records (which
   carry ``replica_id``/``rejected``/``reject_reason``/``spilled``) plus
-  the ``kind="fleet_summary"`` rollup.
+  the ``kind="fleet_summary"`` rollup;
+- the **cost/roofline table** (round 11; ``telemetry/costmodel.py``) —
+  one row per program from ``kind="program_cost"`` records: calls, mean
+  ms, achieved GFLOP/s and GB/s, arithmetic intensity, MFU and the
+  compute-vs-bandwidth bound (ceiling columns render "-" when no device
+  ceiling is known; set PDT_PEAK_FLOPS / PDT_PEAK_GBS);
+- the **anomaly section** (round 11; ``telemetry/anomaly.py``) — count
+  per series plus the latest excursions with their z-scores, from
+  ``kind="anomaly"`` records.
 
 Usage:
     python scripts/telemetry_report.py RUN.jsonl [SERVE.jsonl ...] [--json]
@@ -281,6 +289,84 @@ def fleet_section(records: List[dict], out: dict) -> List[str]:
     return lines
 
 
+def cost_section(records: List[dict], out: dict) -> List[str]:
+    """Per-program MFU/roofline table from ``kind="program_cost"``
+    records (newest record per program wins — a rerun's cards supersede
+    the first run's). The in-runtime generalization of the one-off
+    ``scripts/exp_resnet_roofline.py`` table."""
+    cards: dict = {}
+    for r in records:
+        if r.get("kind") == "program_cost":
+            cards[r["program"]] = r  # newest wins
+    if not cards:
+        return []
+
+    def fmt(v, scale=1.0, digits=1):
+        return f"{v / scale:.{digits}f}" if v is not None else "-"
+
+    lines = ["== program cost / roofline =="]
+    lines.append(_fmt_row(
+        "program", "calls", "mean_ms", "GFLOP/s", "GB/s", "F/B", "MFU",
+        "bound",
+    ))
+    measured = 0
+    # measured programs first (by total time, attribution order), then
+    # the cold remainder alphabetically
+    ordered = sorted(
+        cards.values(),
+        key=lambda r: (-(r.get("total_s") or 0.0), r["program"]),
+    )
+    for r in ordered:
+        if r.get("calls"):
+            measured += 1
+        lines.append(_fmt_row(
+            r["program"][:20],
+            r.get("calls", 0),
+            fmt(r.get("mean_s"), 1e-3, 3) if r.get("calls") else "-",
+            fmt(r.get("achieved_flops_s"), 1e9),
+            fmt(r.get("achieved_bytes_s"), 1e9),
+            fmt(r.get("intensity_flop_b"), 1.0),
+            f"{r['mfu']:.4f}" if r.get("mfu") is not None else "-",
+            r.get("bound", "-"),
+        ))
+    out["cost_programs"] = len(cards)
+    out["cost_measured_programs"] = measured
+    mfus = [r["mfu"] for r in cards.values() if r.get("mfu") is not None]
+    if mfus:
+        out["cost_mfu_max"] = round(max(mfus), 5)
+    bw = [r for r in cards.values() if r.get("bound") == "bandwidth"]
+    if any("bound" in r for r in cards.values()):
+        out["cost_bandwidth_bound"] = len(bw)
+    return lines
+
+
+def anomaly_section(records: List[dict], out: dict) -> List[str]:
+    """Sentinel hits (``kind="anomaly"``): per-series counts and the
+    latest excursions with their z-scores and baselines."""
+    hits = [r for r in records if r.get("kind") == "anomaly"]
+    if not hits:
+        return []
+    by_series: dict = {}
+    for r in hits:
+        by_series.setdefault(r.get("series", "?"), []).append(r)
+    lines = ["== anomalies =="]
+    lines.append("  " + ", ".join(
+        f"{s}={len(rs)}" for s, rs in sorted(by_series.items())
+    ))
+    for r in hits[-5:]:
+        src = f" [{r['source']}]" if r.get("source") else ""
+        lines.append(
+            f"  {r.get('series', '?')}{src}: value "
+            f"{r.get('value', float('nan')):.4g} vs median "
+            f"{r.get('median', float('nan')):.4g} "
+            f"(z={r.get('zscore', float('nan')):.1f})"
+        )
+    out["anomalies"] = len(hits)
+    for s, rs in sorted(by_series.items()):
+        out[f"anomalies_{s}"] = len(rs)
+    return lines
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("paths", nargs="+", help="telemetry JSONL file(s)")
@@ -288,10 +374,10 @@ def main(argv=None) -> int:
                    help="append one flat JSON dict (bench.py style)")
     p.add_argument("--require", default=None,
                    help="comma list of sections that MUST be present "
-                        "(goodput, serving, warmup, fleet) — exit "
-                        "non-zero otherwise; the ci_check.sh "
-                        "--telemetry-smoke, --warmup-smoke and "
-                        "--fleet-smoke gates")
+                        "(goodput, serving, warmup, fleet, cost, "
+                        "anomaly) — exit non-zero otherwise; the "
+                        "ci_check.sh --telemetry-smoke, --warmup-smoke, "
+                        "--fleet-smoke and --obs-smoke gates")
     args = p.parse_args(argv)
 
     records = load_records(args.paths)
@@ -302,38 +388,34 @@ def main(argv=None) -> int:
     lines += train_section(records, out)
     lines += serving_section(records, out)
     lines += fleet_section(records, out)
+    lines += cost_section(records, out)
+    lines += anomaly_section(records, out)
     if not lines:
         print(f"no telemetry records in {args.paths}", file=sys.stderr)
         return 2
     print("\n".join(lines))
-    has_goodput = "goodput_frac" in out
-    has_latency = "serving_ttft_p50_ms" in out
-    has_warmup = "warmup_programs" in out
-    has_fleet = "fleet_replicas" in out
-    if not (has_goodput or has_latency or has_warmup or has_fleet):
-        print("no goodput record, serving latencies, warmup manifest, or "
-              "fleet records found", file=sys.stderr)
+    present = {
+        "goodput": "goodput_frac" in out,
+        "serving": "serving_ttft_p50_ms" in out,
+        "warmup": "warmup_programs" in out,
+        "fleet": "fleet_replicas" in out,
+        "cost": out.get("cost_programs", 0) > 0,
+        "anomaly": out.get("anomalies", 0) > 0,
+    }
+    if not any(present.values()):
+        print("no goodput record, serving latencies, warmup manifest, "
+              "fleet records, cost cards, or anomalies found",
+              file=sys.stderr)
         return 2
     required = {s for s in (args.require or "").split(",") if s}
-    unknown = required - {"goodput", "serving", "warmup", "fleet"}
+    unknown = required - set(present)
     if unknown:
         print(f"--require: unknown sections {sorted(unknown)}",
               file=sys.stderr)
         return 2
-    if "goodput" in required and not has_goodput:
-        print("--require goodput: no goodput record found", file=sys.stderr)
-        return 2
-    if "serving" in required and not has_latency:
-        print("--require serving: no serving latencies found",
-              file=sys.stderr)
-        return 2
-    if "warmup" in required and not has_warmup:
-        print("--require warmup: no warmup manifest records found",
-              file=sys.stderr)
-        return 2
-    if "fleet" in required and not has_fleet:
-        print("--require fleet: no fleet request records found",
-              file=sys.stderr)
+    missing = sorted(s for s in required if not present[s])
+    if missing:
+        print(f"--require: missing section(s) {missing}", file=sys.stderr)
         return 2
     if args.json:
         print(json.dumps(out))

@@ -266,6 +266,40 @@ def test_latency_percentiles_match_numpy_reference():
     assert LatencySeries().summary("x") == {"x_count": 0}
 
 
+def test_latency_edge_cases_empty_single_and_all_equal():
+    """ISSUE 8 satellite: the degenerate series a short or idle run
+    produces — empty, one sample, all-equal — summarize without NaNs,
+    and every percentile of a constant/singleton series IS the value."""
+    # empty: counts only, no stat keys to trip a renderer
+    empty = LatencySeries("e").summary("e")
+    assert empty == {"e_count": 0}
+    assert percentiles([]) == {}
+    assert percentiles([], qs=(1, 50, 99.9)) == {}
+    # single sample: every percentile is the sample, spread is zero
+    s = LatencySeries("one")
+    s.observe(0.25)
+    out = s.summary("one")
+    assert out["one_count"] == 1
+    assert out["one_mean_s"] == out["one_max_s"] == 0.25
+    for q in (50, 95, 99):
+        assert out[f"one_p{q}_s"] == 0.25
+    assert percentiles([0.25], qs=(0, 50, 100)) == {
+        "p0": 0.25, "p50": 0.25, "p100": 0.25
+    }
+    # all-equal: percentiles collapse to the value (no interpolation
+    # artifacts), mean/max agree, nothing is NaN
+    eq = LatencySeries("c")
+    for _ in range(17):
+        eq.observe(1.5)
+    out = eq.summary("c")
+    assert out["c_count"] == 17
+    for k, v in out.items():
+        if k != "c_count":
+            assert v == 1.5, k
+    # and a fractional q on an all-equal series is still exact
+    assert percentiles([2.0] * 5, qs=(99.9,)) == {"p99.9": 2.0}
+
+
 # ---- MetricsLogger hardening --------------------------------------------
 
 
@@ -312,6 +346,47 @@ def test_metrics_logger_rank0_gating_internal(tmp_path, monkeypatch):
     log.log(kind="train", step=1)
     log.close()
     assert os.path.exists(path)  # per-process stream opts out
+
+
+def test_metrics_logger_size_capped_rotation(tmp_path):
+    """ISSUE 8 satellite: with ``max_bytes`` set, a long run's stream
+    rotates to <path>.1 and keeps writing — total disk bounded by ~2x
+    the cap, every record in exactly one generation, no torn lines."""
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    path = os.fspath(tmp_path / "m.jsonl")
+    with MetricsLogger(path, max_bytes=2048) as log:
+        for i in range(200):
+            log.log(kind="train", step=i, pad="x" * 64)
+        rotations = log.rotations
+    assert rotations >= 1
+    assert os.path.exists(f"{path}.1")
+    assert os.path.getsize(path) <= 2048 + 256  # cap + one record slack
+    # both generations parse cleanly line by line (record-aligned
+    # rotation: no torn records at the boundary)
+    newest = [json.loads(l) for l in open(path)]
+    rotated = [json.loads(l) for l in open(f"{path}.1")]
+    steps = [r["step"] for r in rotated] + [r["step"] for r in newest]
+    # the newest history is contiguous and ends at the last record
+    assert steps == list(range(steps[0], 200))
+    assert steps[-1] == 199
+
+
+def test_metrics_logger_reopen_after_rotation_appends(tmp_path):
+    """Rotation regression: a resumed run reopening a rotated stream
+    appends to the ACTIVE generation and keeps rotating from there."""
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    path = os.fspath(tmp_path / "m.jsonl")
+    with MetricsLogger(path, max_bytes=512) as log:
+        for i in range(20):
+            log.log(step=i, pad="y" * 48)
+    with MetricsLogger(path, max_bytes=512) as log:
+        log.log(step=99)
+    newest = [json.loads(l) for l in open(path)]
+    assert newest[-1]["step"] == 99
+    # the pre-reopen tail the resumed run appended AFTER is still there
+    assert len(newest) >= 2 or os.path.exists(f"{path}.1")
 
 
 # ---- trace_device_busy_s multi-run aggregation ---------------------------
