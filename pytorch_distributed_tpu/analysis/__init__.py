@@ -7,26 +7,44 @@ device each iteration, a partition rule that matches nothing leaves a
 parameter replicated. This package catches them before they cost a run:
 
 - ``run_lint`` / ``scripts/jaxlint.py``: AST rules over the package
-  (collective-axis, recompile hazards, host transfers, precision casts);
+  (collective-axis, recompile hazards, host transfers, precision casts)
+  plus the v2 dataflow families (donation use-after-donate/aliasing,
+  shard_map PartitionSpec arity/axis checks, host-thread concurrency);
 - ``partition_coverage.check_partition_coverage``: cross-checks the
   partition rule tables in ``parallel/``/``train/lm.py`` against real
   model parameter trees;
 - ``guards``: runtime companions (``no_recompile``) that wrap a train step
-  and assert-fail on jit cache growth or host transfers after warmup.
+  and assert-fail on jit cache growth or host transfers after warmup;
+- ``sarif``/``cache``: SARIF 2.1.0 emission for CI annotation surfaces
+  and the content-hash incremental mode behind ``--incremental``.
 
 Rules and the ``# jaxlint: disable=<rule>`` suppression syntax are
-documented in ANALYSIS.md at the repo root.
+documented in ANALYSIS.md at the repo root; ``jaxlint --explain RULE``
+prints each rule's long-form text straight from its ``RuleInfo`` — the
+single source the docs defer to.
 """
 
 from pytorch_distributed_tpu.analysis.core import (  # noqa: F401
     Finding,
     LintContext,
     ParsedModule,
+    RuleInfo,
     all_rule_ids,
+    explain_rule,
     load_baseline,
     parse_file,
+    regenerate_baseline,
+    rule_catalog,
     run_lint,
     split_baselined,
+    with_fingerprints,
+)
+from pytorch_distributed_tpu.analysis.cache import (  # noqa: F401
+    run_lint_incremental,
+)
+from pytorch_distributed_tpu.analysis.sarif import (  # noqa: F401
+    to_sarif,
+    write_sarif,
 )
 from pytorch_distributed_tpu.analysis.guards import (  # noqa: F401
     GuardStats,

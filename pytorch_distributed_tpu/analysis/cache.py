@@ -1,0 +1,223 @@
+"""Incremental lint: a per-file content-hash cache.
+
+CI re-lints the whole tree on every push; almost every push changes a
+handful of files. This cache makes the common case cheap without making
+any case wrong:
+
+- **per-file** findings from LOCAL rules (donation, sharding, threads,
+  collectives, recompile, precision) depend only on that file's content
+  plus the run-wide *context* — the union of ``*_AXIS`` constants and
+  mesh axes every module contributes, and the rule version. Each file's
+  entry is keyed by its content sha256; the whole cache is keyed by the
+  context fingerprint, so an axis constant added anywhere invalidates
+  everything (correctly: it can silence or create collective-axis
+  findings in any file).
+- **cross-module** rules (host-transfer walks the package call graph)
+  re-run over the full tree whenever ANY file changed — their findings
+  can move when a callee three modules away gains a ``float()``. Their
+  results are cached as one block, reused only on a fully-unchanged
+  tree.
+
+So: nothing changed → zero parses, zero rule runs. One file changed →
+every file is still *parsed* (the cross pass and the context need the
+tree) but local rules run only on the changed file. The honest win is
+the no-change CI re-run and the long tail of parse-heavy local rules;
+``scripts/ci_check.sh --lint-incremental`` wires it up.
+
+The cache file is an implementation detail (gitignored); a corrupt or
+version-skewed cache degrades to a full run, never to stale findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pytorch_distributed_tpu.analysis.core import (
+    DEFAULT_MESH_AXES,
+    Finding,
+    LintContext,
+    RULE_VERSION,
+    collect_axis_constants,
+    cross_rules,
+    iter_python_files,
+    local_rules,
+    parse_file,
+    with_fingerprints,
+)
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return dataclasses.asdict(f)
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(**d)
+
+
+@dataclasses.dataclass
+class IncrementalResult:
+    findings: List[Finding]
+    linted: int   # files local rules actually ran on
+    cached: int   # files served from cache
+    full_run: bool  # True when the context change forced a full pass
+
+
+class LintCache:
+    """Load/validate/save the JSON cache file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.context: Optional[str] = None
+        self.files: Dict[str, dict] = {}
+        self.cross: List[dict] = []
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("version") != RULE_VERSION:
+                return
+            self.context = data.get("context")
+            self.files = dict(data.get("files", {}))
+            self.cross = list(data.get("cross_findings", []))
+        except (OSError, ValueError, TypeError):
+            return  # absent/corrupt cache = full run
+
+    def save(self) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({
+                "version": RULE_VERSION,
+                "context": self.context,
+                "cross_findings": self.cross,
+                "files": self.files,
+            }, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)
+
+
+def run_lint_incremental(
+    paths: Sequence[str],
+    cache_path: str,
+    rel_root: Optional[str] = None,
+    extra_axes: Sequence[str] = (),
+) -> IncrementalResult:
+    """``run_lint`` semantics (suppressions applied, sorted, fingerprinted)
+    backed by the content-hash cache."""
+    cache = LintCache(cache_path)
+    files = iter_python_files(paths)
+
+    hashes: Dict[str, str] = {}
+    rels: Dict[str, str] = {}
+    blobs: Dict[str, bytes] = {}
+    for f in files:
+        with open(f, "rb") as fh:
+            blob = fh.read()
+        rel = (
+            os.path.relpath(f, rel_root) if rel_root else f
+        ).replace(os.sep, "/")
+        hashes[rel] = _sha(blob)
+        rels[rel] = f
+        blobs[rel] = blob
+
+    known = set(cache.files)
+    unchanged = {
+        rel for rel, h in hashes.items()
+        if rel in known and cache.files[rel].get("sha") == h
+    }
+    changed = [rel for rel in hashes if rel not in unchanged]
+    # a deleted file's cached findings must not survive it — and the
+    # deletion is itself a change: it can shrink the axis-constant
+    # context and remove call-graph nodes the cross rules walked
+    deleted = known - set(hashes)
+    for rel in deleted:
+        del cache.files[rel]
+
+    if not changed and not deleted and cache.context is not None:
+        findings = [
+            _finding_from_dict(d)
+            for rel in sorted(hashes)
+            for d in cache.files[rel].get("findings", [])
+        ] + [_finding_from_dict(d) for d in cache.cross]
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return IncrementalResult(findings, 0, len(hashes), False)
+
+    # something changed: parse the whole tree (the cross pass and the
+    # axis-constant context need every module anyway)
+    modules = [parse_file(rels[rel], rel_root) for rel in sorted(hashes)]
+    by_rel = {m.path: m for m in modules}
+    consts = collect_axis_constants(modules)
+    axes = set(DEFAULT_MESH_AXES) | set(consts.values()) | set(extra_axes)
+    context = _sha(json.dumps(
+        [RULE_VERSION, sorted(consts.items()), sorted(axes)],
+        separators=(",", ":"),
+    ).encode())
+    full_run = context != cache.context
+    if full_run:
+        changed = list(hashes)
+        unchanged = set()
+    ctx = LintContext(
+        modules=modules, mesh_axes=axes, axis_constants=consts
+    )
+
+    sources = {m.path: m.lines for m in modules}
+
+    def apply(rule, mod):
+        out = []
+        for f in rule(mod, ctx):
+            owner = by_rel.get(f.path, mod)
+            if not owner.is_suppressed(f.rule, f.line):
+                out.append(f)
+        return out
+
+    # local rules: changed files only
+    for rel in changed:
+        mod = by_rel[rel]
+        file_findings: List[Finding] = []
+        for rule in local_rules():
+            file_findings.extend(apply(rule, mod))
+        uniq: Dict[Tuple[str, str, int], Finding] = {}
+        for f in file_findings:
+            uniq.setdefault((f.rule, f.path, f.line), f)
+        fps = with_fingerprints(
+            sorted(uniq.values(), key=lambda f: (f.path, f.line, f.rule)),
+            sources,
+        )
+        cache.files[rel] = {
+            "sha": hashes[rel],
+            "findings": [_finding_to_dict(f) for f in fps],
+        }
+
+    # cross rules: full tree on any change
+    cross_findings: List[Finding] = []
+    for rule in cross_rules():
+        for mod in modules:
+            cross_findings.extend(apply(rule, mod))
+    uniq = {}
+    for f in cross_findings:
+        uniq.setdefault((f.rule, f.path, f.line), f)
+    cross_fps = with_fingerprints(
+        sorted(uniq.values(), key=lambda f: (f.path, f.line, f.rule)),
+        sources,
+    )
+    cache.cross = [_finding_to_dict(f) for f in cross_fps]
+    cache.context = context
+    cache.save()
+
+    findings = [
+        _finding_from_dict(d)
+        for rel in sorted(hashes)
+        for d in cache.files[rel].get("findings", [])
+    ] + list(cross_fps)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return IncrementalResult(
+        findings, len(changed), len(hashes) - len(changed), full_run
+    )

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -30,16 +31,74 @@ _SUPPRESS_FILE_RE = re.compile(
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One lint result: ``file:line: rule severity: message``."""
+    """One lint result: ``file:line: rule severity: message``.
+
+    ``fingerprint`` is a stable identity derived from (rule, file, line
+    CONTENT, occurrence index) — not the line number — so it survives
+    unrelated edits to the same file; SARIF consumers and the baseline
+    both key on content this way. Rules leave it empty; ``run_lint``
+    (and the CLI, for runtime rules) fills it in.
+    """
 
     rule: str
     severity: str  # "error" | "warning"
     path: str  # repo-relative
     line: int
     message: str
+    fingerprint: str = ""
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.severity}: {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleInfo:
+    """Catalogue entry for one rule id.
+
+    ``explain`` is the long-form text behind ``jaxlint --explain RULE``;
+    it lives here, next to the implementation, so the CLI help and
+    ANALYSIS.md (which defers to ``--explain``) cannot drift from what
+    the rule actually checks.
+    """
+
+    rule: str
+    severity: str
+    short: str
+    explain: str
+
+
+def _fingerprint(rule: str, path: str, content: str, occurrence: int) -> str:
+    key = f"{rule}|{path}|{content}|{occurrence}"
+    return hashlib.sha256(key.encode("utf-8")).hexdigest()[:16]
+
+
+def with_fingerprints(
+    findings: Sequence[Finding], sources: Dict[str, Sequence[str]]
+) -> List[Finding]:
+    """Fill each finding's stable fingerprint from its line content.
+
+    Two identical lines in one file firing the same rule disambiguate by
+    occurrence index (in line order), keeping fingerprints unique and
+    deterministic. Findings that already carry a fingerprint pass
+    through untouched.
+    """
+    counts: Dict[Tuple[str, str, str], int] = {}
+    out: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if f.fingerprint:
+            out.append(f)
+            continue
+        lines = sources.get(f.path, ())
+        content = (
+            lines[f.line - 1].strip() if 0 < f.line <= len(lines) else f.message
+        )
+        key = (f.rule, f.path, content)
+        n = counts.get(key, 0)
+        counts[key] = n + 1
+        out.append(dataclasses.replace(
+            f, fingerprint=_fingerprint(f.rule, f.path, content, n)
+        ))
+    return out
 
 
 @dataclasses.dataclass
@@ -143,61 +202,92 @@ def collect_axis_constants(modules: Sequence[ParsedModule]) -> Dict[str, str]:
 
 Rule = Callable[[ParsedModule, LintContext], List[Finding]]
 
+#: bump when any rule's behaviour changes — invalidates incremental caches
+RULE_VERSION = "jaxlint-2.0"
 
-def default_rules() -> List[Rule]:
-    from pytorch_distributed_tpu.analysis.rules_collectives import (
-        check_collective_axes,
-    )
-    from pytorch_distributed_tpu.analysis.rules_host_transfer import (
-        check_host_transfers,
-    )
-    from pytorch_distributed_tpu.analysis.rules_precision import (
-        check_precision_casts,
-    )
-    from pytorch_distributed_tpu.analysis.rules_recompile import (
-        check_recompile_hazards,
+# partition-coverage is the one rule whose implementation needs a live
+# jax import, so its catalogue entry lives here (stdlib territory), not
+# in its module.
+_PARTITION_COVERAGE_INFO = RuleInfo(
+    "partition-coverage", "error",
+    "partition rule table leaves a shardable parameter replicated, or "
+    "contains a rule matching no parameter",
+    "Runtime cross-check of the partition rule tables in parallel// "
+    "train/lm.py against real model parameter trees: every shardable "
+    "parameter (ndim >= 2) must be matched by some rule, and every rule "
+    "must match at least one parameter. A rule regex that drifts from a "
+    "renamed module silently replicates the tensor FSDP was supposed to "
+    "shard — this check needs an importable jax and degrades to a "
+    "skipped notice without one.",
+)
+
+
+def _rule_modules():
+    from pytorch_distributed_tpu.analysis import (
+        rules_collectives,
+        rules_donation,
+        rules_host_transfer,
+        rules_precision,
+        rules_recompile,
+        rules_sharding,
+        rules_threads,
     )
 
     return [
-        check_collective_axes,
-        check_recompile_hazards,
-        check_host_transfers,
-        check_precision_casts,
+        rules_collectives,
+        rules_recompile,
+        rules_host_transfer,
+        rules_precision,
+        rules_donation,
+        rules_sharding,
+        rules_threads,
     ]
+
+
+def rule_catalog() -> List[RuleInfo]:
+    """Every shipped rule's catalogue entry, AST rules first."""
+    out: List[RuleInfo] = []
+    for mod in _rule_modules():
+        out.extend(mod.RULES)
+    out.append(_PARTITION_COVERAGE_INFO)
+    return out
+
+
+def default_rules() -> List[Rule]:
+    return [mod.CHECK for mod in _rule_modules()]
+
+
+def local_rules() -> List[Rule]:
+    """Rules whose findings depend only on one file's content (given the
+    run's axis-constant context) — safe to cache per file."""
+    return [mod.CHECK for mod in _rule_modules() if not mod.CROSS_MODULE]
+
+
+def cross_rules() -> List[Rule]:
+    """Rules that walk the whole-package call graph; their findings can
+    move when ANY file changes, so the incremental cache re-runs them on
+    every non-empty change set."""
+    return [mod.CHECK for mod in _rule_modules() if mod.CROSS_MODULE]
 
 
 def all_rule_ids() -> List[Tuple[str, str, str]]:
     """(rule id, severity, one-line description) for --list-rules."""
-    return [
-        ("collective-axis", "error",
-         "collective uses an axis name no mesh/shard_map declares"),
-        ("collective-axis-literal", "warning",
-         "collective spells a mesh axis as a string literal instead of the "
-         "shared *_AXIS constant"),
-        ("collective-axis-inconsistent", "warning",
-         "same collective op on the same operand uses two different axis "
-         "names in one function"),
-        ("recompile-traced-branch", "error",
-         "Python if/while on a traced argument of a jit-compiled function"),
-        ("recompile-jit-call", "warning",
-         "jax.jit(...)(...) invoked immediately inside a function — the "
-         "compile cache is discarded every call"),
-        ("recompile-mutable-closure", "warning",
-         "jit-compiled function closes over a module-level mutable that the "
-         "module mutates elsewhere"),
-        ("recompile-static-argnums", "error",
-         "static_argnums out of range, overlapping donate_argnums, or "
-         "marking a non-hashable (list/dict-default) parameter"),
-        ("host-transfer", "error",
-         "float()/np.asarray()/.item()/device_get reachable from a compiled "
-         "train-step body"),
-        ("partition-coverage", "error",
-         "partition rule table leaves a shardable parameter replicated, or "
-         "contains a rule matching no parameter"),
-        ("precision-cast", "warning",
-         "literal f32/bf16 cast in ops/ outside ops/precision.py policy "
-         "helpers"),
-    ]
+    return [(r.rule, r.severity, r.short) for r in rule_catalog()]
+
+
+def explain_rule(rule_id: str) -> Optional[str]:
+    """Long-form ``--explain`` text for one rule id, or None."""
+    for r in rule_catalog():
+        if r.rule == rule_id:
+            return (
+                f"{r.rule} ({r.severity})\n"
+                f"{'=' * (len(r.rule) + len(r.severity) + 3)}\n"
+                f"{r.short}\n\n{r.explain}\n\n"
+                f"Suppress with '# jaxlint: disable={r.rule} -- <reason>' "
+                f"(or disable-file= for a whole file); reviewed "
+                f"pre-existing findings live in scripts/jaxlint_baseline.json."
+            )
+    return None
 
 
 def run_lint(
@@ -229,8 +319,10 @@ def run_lint(
                 if owner.is_suppressed(f.rule, f.line):
                     continue
                 findings.setdefault((f.rule, f.path, f.line), f)
-    return sorted(
-        findings.values(), key=lambda f: (f.path, f.line, f.rule)
+    sources = {m.path: m.lines for m in modules}
+    return with_fingerprints(
+        sorted(findings.values(), key=lambda f: (f.path, f.line, f.rule)),
+        sources,
     )
 
 
@@ -279,3 +371,54 @@ def split_baselined(
         )
         (old if matched else new).append(f)
     return new, old
+
+
+UNREVIEWED_REASON = "UNREVIEWED: justify this entry or fix the finding"
+
+
+def regenerate_baseline(
+    findings: Sequence[Finding],
+    old_entries: Sequence[dict],
+    sources: Dict[str, Sequence[str]],
+) -> dict:
+    """``--fix-baseline``: rebuild the baseline from the current findings.
+
+    Deterministic order (file, line content, rule); reasons of surviving
+    entries are preserved by (rule, file, line_content) match, entries
+    whose finding disappeared are dropped (the baseline shrinks), and a
+    finding not previously baselined gets the UNREVIEWED placeholder —
+    CI reviewers must replace it or fix the code.
+    """
+    reasons = {
+        (e["rule"], e["file"], e["line_content"]): e["reason"]
+        for e in old_entries
+    }
+    entries = []
+    seen = set()
+    for f in findings:
+        lines = sources.get(f.path, ())
+        content = (
+            lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        )
+        key = (f.rule, f.path, content)
+        if key in seen:  # two hits on identical content: one entry covers both
+            continue
+        seen.add(key)
+        entries.append({
+            "rule": f.rule,
+            "file": f.path,
+            "line_content": content,
+            "reason": reasons.get(key, UNREVIEWED_REASON),
+        })
+    entries.sort(key=lambda e: (e["file"], e["line_content"], e["rule"]))
+    return {
+        "_comment": (
+            "Reviewed pre-existing jaxlint findings. Entries match on "
+            "(rule, file, stripped line content) so they survive unrelated "
+            "edits; delete an entry when its finding is fixed. Regenerate "
+            "with scripts/jaxlint.py --fix-baseline after burning findings "
+            "down — the baseline must only ever shrink. New findings are "
+            "NOT covered and fail CI."
+        ),
+        "findings": entries,
+    }

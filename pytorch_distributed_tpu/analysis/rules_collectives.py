@@ -38,7 +38,43 @@ from pytorch_distributed_tpu.analysis.core import (
     Finding,
     LintContext,
     ParsedModule,
+    RuleInfo,
 )
+
+RULES = [
+    RuleInfo(
+        "collective-axis", "error",
+        "collective uses an axis name no mesh/shard_map declares",
+        "Every jax.lax.psum/pmean/pmax/pmin/psum_scatter/all_gather/"
+        "ppermute/all_to_all/axis_index call must name an axis that is "
+        "actually declared: a *_AXIS constant (the parallel/mesh.py grid "
+        "data/seq/model), a Mesh(axis_names=...)/make_mesh literal, or a "
+        "pmap(axis_name=...) in the same module. A mistyped axis that "
+        "happens to bind to the WRONG axis trains on wrong math with no "
+        "error at all. Axis arguments are resolved through constants, "
+        "imports, tuples and parameter defaults; opaque values are "
+        "skipped, never guessed.",
+    ),
+    RuleInfo(
+        "collective-axis-literal", "warning",
+        "collective spells a mesh axis as a string literal instead of the "
+        "shared *_AXIS constant",
+        "The axis exists but is spelled as a raw string where a shared "
+        "*_AXIS constant is defined. Literal spellings are how call sites "
+        "drift apart across modules and hosts — route the name through "
+        "parallel.mesh.DATA_AXIS et al. so a rename is one edit.",
+    ),
+    RuleInfo(
+        "collective-axis-inconsistent", "warning",
+        "same collective op on the same operand uses two different axis "
+        "names in one function",
+        "Within one function, the same collective op applied to the same "
+        "named operand resolves to two different axis sets — the 'same "
+        "logical collective, different axis name' hazard left behind by "
+        "mismatched refactors. One of the two sites is combining over "
+        "the wrong axis.",
+    ),
+]
 
 # op name -> (positional index of the axis argument, its keyword name)
 COLLECTIVES: Dict[str, Tuple[int, str]] = {
@@ -229,3 +265,7 @@ def check_collective_axes(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
 
     visit(mod.tree, [])
     return findings
+
+
+CHECK = check_collective_axes
+CROSS_MODULE = False

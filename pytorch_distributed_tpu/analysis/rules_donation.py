@@ -1,0 +1,575 @@
+"""Rule family: donation — dataflow over donated jit buffers.
+
+``donate_argnums`` is how every hot path in this repo stays at one
+allocation (the KV pool, the logits buffer, TrainState, the metrics
+ring): the donated input's buffer is reused for the output. The flip
+side is a hazard jax only reports lazily (a ``Deleted buffer`` error on
+some later access, often far from the donating call) or not at all (on
+backends that copy): reading a buffer AFTER donating it. This family
+runs an intraprocedural (per-function, per-module) dataflow over every
+jit call site whose ``donate_argnums``/``donate_argnames`` the lint can
+see:
+
+- ``donation-use-after-donate`` (error): a variable or ``self.attr``
+  passed at a donated position is read again after the donating call
+  without being rebound (the repo's idiom rebinds it from the result in
+  the same statement: ``self.cache, self.logits = fn(..., self.cache,
+  self.logits, ...)``). A donating call inside a loop whose donated
+  operand is never rebound in the loop body is the same bug one
+  iteration later and is flagged at the call.
+- ``donation-alias`` (error): the same buffer expression appears at two
+  argument positions of one donating call with at least one of them
+  donated — the donated buffer is aliased, so the other reference is
+  invalidated mid-call (jax raises on some backends, silently copies on
+  others).
+- ``donation-none-hot-loop`` (warning): a call to a KNOWN jitted
+  callable that donates nothing, inside a ``for``/``while`` loop, whose
+  result rebinds one of its own arguments — the carry idiom
+  (``state = step(state, batch)``) paying a full output allocation per
+  iteration that ``donate_argnums`` would eliminate.
+
+Donation signatures are resolved through the repo's builder idioms: a
+direct ``fn = jax.jit(body, donate_argnums=...)``, the attribute form
+``self._push = jax.jit(...)``, builder functions/methods that *return* a
+jitted callable (``def _chunk_fn(...): fn = jax.jit(body, ...); return
+fn``), and chained builder calls (``self._import_fn(n)(...)``). Name
+resolution is lexically scoped (innermost function first, then module
+scope); ``self.X`` signatures are scoped per class.
+
+Known false-negative boundary (ANALYSIS.md "jaxlint v2"): the analysis
+is intraprocedural — a donated ``self.cache`` read from a *different*
+method, or a jitted callable built in one module and called from
+another, is out of static reach. The runtime companions (token-identity
+tests, ``no_recompile``) cover those.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pytorch_distributed_tpu.analysis._astutil import (
+    dotted,
+    get_kwarg,
+    int_constants,
+    terminal_name,
+)
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ParsedModule,
+    RuleInfo,
+)
+
+RULES = [
+    RuleInfo(
+        "donation-use-after-donate", "error",
+        "buffer read again after being passed at a donated jit position",
+        "A buffer passed at a donate_argnums/donate_argnames position is "
+        "consumed by the call: its underlying memory becomes the "
+        "output's. Reading the old reference afterwards is "
+        "use-after-free dressed as numerics — jax raises a deleted-"
+        "buffer error on some backends and silently copies on others, "
+        "so the bug ships quietly on CPU and detonates on TPU. The fix "
+        "is the repo's standard idiom: rebind the donated reference "
+        "from the call's result in the same statement (self.cache, "
+        "self.logits = fn(..., self.cache, self.logits, ...)). A "
+        "donating call in a loop must rebind its donated operand "
+        "somewhere in the loop body, or the next iteration re-passes a "
+        "dead buffer. The analysis is intraprocedural: reads from other "
+        "methods/modules are out of scope (documented false-negative "
+        "boundary).",
+    ),
+    RuleInfo(
+        "donation-alias", "error",
+        "same buffer passed twice to one donating call (donated alias)",
+        "One call passing the same variable/attribute at two argument "
+        "positions, at least one donated, aliases the donated buffer: "
+        "the callee receives two views of memory the donation is about "
+        "to recycle. jax rejects some of these at dispatch and silently "
+        "copies others — either way the program is not expressing what "
+        "it means. Pass distinct buffers, or drop the donation (see "
+        "ops/metrics.py's four-distinct-zeros construction for the "
+        "pytree variant of this bug).",
+    ),
+    RuleInfo(
+        "donation-none-hot-loop", "warning",
+        "loop-carried jit call donates nothing — one dead allocation "
+        "per iteration",
+        "A jitted callable invoked in a for/while loop whose result "
+        "rebinds one of its own arguments is a carry chain (state = "
+        "step(state, batch)). Without donate_argnums the output cannot "
+        "reuse the input's buffer, so every iteration allocates a full "
+        "new carry and frees the old one — at training-state sizes this "
+        "is real HBM churn and allocator pressure on the hot path. Mark "
+        "the carried argument donated (and keep rebinding from the "
+        "result). Flagged only for callables whose jit construction is "
+        "visible in the same module; perf warning, not a correctness "
+        "error.",
+    ),
+]
+
+#: donation signature: (donated positional indices, donated kwarg names);
+#: ((), ()) means "known-jitted, donates nothing" — tracked for the
+#: hot-loop warning.
+Sig = Tuple[Tuple[int, ...], Tuple[str, ...]]
+
+_NONE_SIG: Sig = ((), ())
+
+
+def _jit_sig(call: ast.Call) -> Optional[Sig]:
+    """Donation signature if ``call`` is a jit/pjit construction."""
+    if terminal_name(call) not in ("jit", "pjit"):
+        return None
+    nums_node = get_kwarg(call, "donate_argnums")
+    nums = tuple(int_constants(nums_node) or ()) if nums_node is not None else ()
+    names_node = get_kwarg(call, "donate_argnames")
+    names: Tuple[str, ...] = ()
+    if names_node is not None:
+        if isinstance(names_node, ast.Constant) and isinstance(
+            names_node.value, str
+        ):
+            names = (names_node.value,)
+        elif isinstance(names_node, (ast.Tuple, ast.List)):
+            names = tuple(
+                e.value for e in names_node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return (nums, names)
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """'X' for a ``self.X`` expression, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ModuleSigs:
+    """Pass 1: where donation signatures are born in this module.
+
+    ``fn_builders``  function/method name -> Sig, for defs that return a
+                     jitted callable (directly or via a local name/attr).
+    ``class_attrs``  class name -> {attr -> Sig} for ``self.X = jax.jit``.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.fn_builders: Dict[str, Sig] = {}
+        self.class_attrs: Dict[str, Dict[str, Sig]] = {}
+        self._scan(tree, None)
+
+    def _scan(self, node: ast.AST, class_name: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._scan(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sig = self._builder_sig(child)
+                if sig is not None:
+                    self.fn_builders[child.name] = sig
+                self._collect_attr_sigs(child, class_name)
+                self._scan(child, class_name)
+            else:
+                self._scan(child, class_name)
+
+    def _collect_attr_sigs(self, fn, class_name: Optional[str]):
+        if class_name is None:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                sig = _jit_sig(node.value)
+                if sig is None:
+                    continue
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        self.class_attrs.setdefault(class_name, {})[attr] = sig
+
+    @staticmethod
+    def _builder_sig(fn) -> Optional[Sig]:
+        """Sig when ``fn`` returns a jitted callable it constructs."""
+        own = [n for stmt in fn.body for n in _own_nodes(stmt)]
+        local: Dict[str, Sig] = {}
+        attr_local: Dict[str, Sig] = {}
+        for node in own:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                sig = _jit_sig(node.value)
+                if sig is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local[t.id] = sig
+                    else:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            attr_local[attr] = sig
+        for node in own:
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                sig = _jit_sig(v)
+                if sig is not None:
+                    return sig
+            elif isinstance(v, ast.Name) and v.id in local:
+                return local[v.id]
+            else:
+                attr = _self_attr(v)
+                if attr is not None and attr in attr_local:
+                    return attr_local[attr]
+        return None
+
+
+# ---- pass 2: per-scope event analysis --------------------------------------
+
+
+class _Event:
+    """One linearized statement with branch context."""
+
+    __slots__ = ("stmt", "path", "loops", "index")
+
+    def __init__(self, stmt, path, loops, index):
+        self.stmt = stmt
+        self.path = path    # tuple of (id(If-node), arm) ancestors
+        self.loops = loops  # tuple of enclosing For/While nodes
+        self.index = index
+
+
+def _linearize(body: Sequence[ast.stmt]) -> List[_Event]:
+    events: List[_Event] = []
+
+    def walk(block, path, loops):
+        for stmt in block:
+            events.append(_Event(stmt, path, loops, len(events)))
+            if isinstance(stmt, ast.If):
+                walk(stmt.body, path + ((id(stmt), 0),), loops)
+                walk(stmt.orelse, path + ((id(stmt), 1),), loops)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                walk(stmt.body, path, loops + (stmt,))
+                walk(stmt.orelse, path, loops)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                walk(stmt.body, path, loops)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, path, loops)
+                for h in stmt.handlers:
+                    walk(h.body, path, loops)
+                walk(stmt.orelse, path, loops)
+                walk(stmt.finalbody, path, loops)
+
+    walk(body, (), ())
+    return events
+
+
+def _own_nodes(stmt: ast.stmt):
+    """Walk a statement WITHOUT descending into nested defs/classes/
+    lambdas (their bodies execute at some other time — analyzed as their
+    own scopes, or deliberately out of reach for lambdas), and WITHOUT
+    descending into compound-statement bodies — those are separate
+    events of the linearization; this yields only the statement's own
+    header (an If's test, a For's target/iter, a With's items)."""
+    if isinstance(
+        stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ):
+        return
+    if isinstance(stmt, (ast.If, ast.While)):
+        roots: List[ast.AST] = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        roots = [stmt.target, stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [i.context_expr for i in stmt.items]
+        roots += [i.optional_vars for i in stmt.items if i.optional_vars]
+    elif isinstance(stmt, ast.Try):
+        roots = []
+    else:
+        roots = [stmt]
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda),
+            ):
+                continue
+            stack.append(child)
+
+
+def _chains_read(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    for node in _own_nodes(stmt):
+        if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), ast.Load
+        ):
+            d = dotted(node)
+            if d is not None:
+                out.add(d)
+    return out
+
+
+def _target_chains(t: ast.expr) -> Set[str]:
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in t.elts:
+            out |= _target_chains(e)
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_chains(t.value)
+    d = dotted(t)
+    return {d} if d is not None else set()
+
+
+def _chains_rebound(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return _target_chains(stmt.target)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                out |= _target_chains(item.optional_vars)
+        return out
+    for node in _own_nodes(stmt):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                out |= _target_chains(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            out |= _target_chains(node.target)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                out |= _target_chains(t)
+    return out
+
+
+def _compatible(donate_path, other_path) -> bool:
+    """Can control flow reach ``other`` from ``donate`` branch-wise?
+    Divergent arms of one If are mutually unreachable."""
+    for (if_id, arm) in donate_path:
+        for (oid, oarm) in other_path:
+            if oid == if_id and oarm != arm:
+                return False
+    return True
+
+
+def _is_terminal(stmt: ast.stmt) -> bool:
+    return isinstance(stmt, (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+class _DonationScope:
+    """One function (or the module body): resolve callables, then walk
+    the linearized statements for the three donation hazards."""
+
+    def __init__(self, mod: ParsedModule, sigs: _ModuleSigs,
+                 findings: List[Finding]):
+        self.mod = mod
+        self.sigs = sigs
+        self.findings = findings
+
+    def analyze(self, body: Sequence[ast.stmt], scopes: List[Dict[str, Sig]],
+                class_name: Optional[str]):
+        local = self._local_names(body, scopes, class_name)
+        scopes = scopes + [local]
+        events = _linearize(body)
+        for ev in events:
+            for call in self._donating_calls(ev.stmt, scopes, class_name):
+                self._check_call(call[0], call[1], ev, events, class_name)
+        # nested defs/classes see this scope's names
+        for ev in events:
+            stmt = ev.stmt
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.analyze(stmt.body, scopes, class_name)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.analyze(sub.body, scopes, stmt.name)
+
+    # -- callable resolution ------------------------------------------------
+
+    def _local_names(self, body, scopes, class_name) -> Dict[str, Sig]:
+        """Names bound in THIS scope to jitted callables: direct jit
+        assignments, builder-call results, and aliases of donating
+        self-attrs."""
+        local: Dict[str, Sig] = {}
+        for ev in _linearize(list(body)):
+            stmt = ev.stmt
+            for node in _own_nodes(stmt):
+                if not isinstance(node, ast.Assign):
+                    continue
+                sig = self._value_sig(node.value, scopes + [local], class_name)
+                if sig is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local[t.id] = sig
+        return local
+
+    def _value_sig(self, value: ast.expr, scopes, class_name) -> Optional[Sig]:
+        """Donation signature of an assigned value, if it is a jitted
+        callable we can see: jax.jit(...), a builder call, or an alias
+        of a donating self-attr."""
+        if isinstance(value, ast.Call):
+            sig = _jit_sig(value)
+            if sig is not None:
+                return sig
+            return self._callee_builder_sig(value, class_name)
+        attr = _self_attr(value)
+        if attr is not None and class_name is not None:
+            return self.sigs.class_attrs.get(class_name, {}).get(attr)
+        return None
+
+    def _callee_builder_sig(self, call: ast.Call, class_name) -> Optional[Sig]:
+        """Sig when ``call`` invokes a builder that returns a jitted fn."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.sigs.fn_builders.get(f.id)
+        attr = _self_attr(f)
+        if attr is not None:
+            return self.sigs.fn_builders.get(attr)
+        return None
+
+    def _resolve_callee(self, call: ast.Call, scopes, class_name) -> Optional[Sig]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            for scope in reversed(scopes):
+                if f.id in scope:
+                    return scope[f.id]
+            return None
+        attr = _self_attr(f)
+        if attr is not None and class_name is not None:
+            return self.sigs.class_attrs.get(class_name, {}).get(attr)
+        if isinstance(f, ast.Call):
+            # chained builder: self._import_fn(n)(args...)
+            return self._callee_builder_sig(f, class_name)
+        return None
+
+    def _donating_calls(self, stmt, scopes, class_name):
+        out = []
+        for node in _own_nodes(stmt):
+            if isinstance(node, ast.Call):
+                sig = self._resolve_callee(node, scopes, class_name)
+                if sig is not None:
+                    out.append((node, sig))
+        return out
+
+    # -- the checks ---------------------------------------------------------
+
+    def _donated_chains(self, call: ast.Call, sig: Sig) -> List[str]:
+        nums, kwnames = sig
+        chains: List[str] = []
+        for i in nums:
+            if 0 <= i < len(call.args) and not any(
+                isinstance(a, ast.Starred) for a in call.args[: i + 1]
+            ):
+                d = dotted(call.args[i])
+                if d is not None:
+                    chains.append(d)
+        for kw in call.keywords:
+            if kw.arg in kwnames:
+                d = dotted(kw.value)
+                if d is not None:
+                    chains.append(d)
+        return chains
+
+    def _check_call(self, call: ast.Call, sig: Sig, ev: _Event,
+                    events: List[_Event], class_name) -> None:
+        donated = self._donated_chains(call, sig)
+        rebound_here = _chains_rebound(ev.stmt)
+
+        if not donated:
+            self._check_hot_loop(call, sig, ev, rebound_here)
+            return
+
+        # alias: a donated chain appearing anywhere else in the same call
+        all_args = [dotted(a) for a in call.args] + [
+            dotted(kw.value) for kw in call.keywords
+        ]
+        for chain in set(donated):
+            count = sum(1 for d in all_args if d == chain)
+            if count > 1 or donated.count(chain) > 1:
+                self.findings.append(Finding(
+                    "donation-alias", "error", self.mod.path, call.lineno,
+                    f"{chain!r} is passed {count} times to one donating "
+                    f"call with a donated position among them — the "
+                    f"donated buffer is aliased; pass distinct buffers "
+                    f"or drop the donation",
+                ))
+
+        # use-after-donate, linear scan with branch compatibility
+        for chain in dict.fromkeys(donated):  # ordered unique
+            if chain in rebound_here:
+                continue  # consumed correctly at the donating statement
+            self._scan_after(chain, call, ev, events)
+            self._check_loop_rebind(chain, call, ev, events)
+
+    def _scan_after(self, chain: str, call: ast.Call, ev: _Event,
+                    events: List[_Event]) -> None:
+        for later in events[ev.index + 1:]:
+            if not _compatible(ev.path, later.path):
+                continue
+            read_here = chain in _chains_read(later.stmt)
+            if not read_here:
+                if chain in _chains_rebound(later.stmt):
+                    return  # rebound before any read we could prove
+                # a return/raise in the donate's own arm ends its flow
+                if later.path == ev.path and _is_terminal(later.stmt):
+                    return
+                continue
+            if read_here:
+                self.findings.append(Finding(
+                    "donation-use-after-donate", "error", self.mod.path,
+                    later.stmt.lineno,
+                    f"{chain!r} was donated to the jit call at line "
+                    f"{call.lineno} and is read here without being "
+                    f"rebound — its buffer now belongs to that call's "
+                    f"output (rebind it from the result: "
+                    f"`{chain}, ... = fn(..., {chain}, ...)`)",
+                ))
+                return  # one finding per donated chain
+        return
+
+    def _check_loop_rebind(self, chain: str, call: ast.Call, ev: _Event,
+                           events: List[_Event]) -> None:
+        if not ev.loops:
+            return
+        loop = ev.loops[-1]
+        for other in events:
+            if other.loops and loop in other.loops and chain in _chains_rebound(
+                other.stmt
+            ):
+                return
+        self.findings.append(Finding(
+            "donation-use-after-donate", "error", self.mod.path, call.lineno,
+            f"{chain!r} is donated inside this loop but never rebound in "
+            f"the loop body — the next iteration re-passes a buffer the "
+            f"previous call already consumed",
+        ))
+
+    def _check_hot_loop(self, call: ast.Call, sig: Sig, ev: _Event,
+                        rebound_here: Set[str]) -> None:
+        if sig != _NONE_SIG or not ev.loops:
+            return
+        arg_chains = {d for d in (dotted(a) for a in call.args) if d}
+        carried = sorted(arg_chains & rebound_here)
+        if carried:
+            self.findings.append(Finding(
+                "donation-none-hot-loop", "warning", self.mod.path,
+                call.lineno,
+                f"loop-carried jit call rebinds its own argument(s) "
+                f"{carried} but donates nothing — every iteration "
+                f"allocates a fresh carry; add donate_argnums for the "
+                f"carried buffer(s)",
+            ))
+
+
+def check_donation(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
+    sigs = _ModuleSigs(mod.tree)
+    findings: List[Finding] = []
+    scope = _DonationScope(mod, sigs, findings)
+    scope.analyze(mod.tree.body, [], None)
+    return findings
+
+
+CHECK = check_donation
+CROSS_MODULE = False

@@ -37,7 +37,25 @@ from pytorch_distributed_tpu.analysis.core import (
     Finding,
     LintContext,
     ParsedModule,
+    RuleInfo,
 )
+
+RULES = [
+    RuleInfo(
+        "host-transfer", "error",
+        "float()/np.asarray()/.item()/device_get reachable from a "
+        "compiled train-step body",
+        "float(x), np.asarray(x), x.item() and jax.device_get block "
+        "until the async dispatch queue drains; inside the train step's "
+        "call tree they serialize every step on a device-to-host round "
+        "trip. The lint walks the whole-package static call graph from "
+        "the compiled step bodies (_local_* functions and make_* builder "
+        "nests in train/), resolving calls through package imports and "
+        "class methods, and reports each reachable sync with the call "
+        "chain from the root. Dynamic dispatch is outside static reach; "
+        "the runtime companion analysis.guards.no_recompile covers it.",
+    ),
+]
 
 _NUMPY_SYNCS = {"asarray", "array"}
 
@@ -231,3 +249,7 @@ def check_host_transfers(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
     for f in findings:
         unique.setdefault((f.path, f.line, f.message.split(" (")[0]), f)
     return list(unique.values())
+
+
+CHECK = check_host_transfers
+CROSS_MODULE = True  # findings move when any file in the call graph changes

@@ -30,7 +30,26 @@ from pytorch_distributed_tpu.analysis.core import (
     Finding,
     LintContext,
     ParsedModule,
+    RuleInfo,
 )
+
+RULES = [
+    RuleInfo(
+        "precision-cast", "warning",
+        "literal f32/bf16 cast in ops/ outside ops/precision.py policy "
+        "helpers",
+        "The mixed-precision contract (params f32, compute bf16, outputs "
+        "f32) is owned by ops.precision.Policy; an inline "
+        ".astype(jnp.bfloat16) inside an op silently overrides the "
+        "policy for every caller — including the fp32 baseline recipes "
+        "that exist to measure bf16 against. Intentional sites (fp32 "
+        "kernel accumulators, loss upcasts required for numerics) stay, "
+        "with an inline suppression or a baseline entry — either way "
+        "the reason is recorded next to the cast. Policy-driven casts "
+        "(x.astype(self.compute_dtype), x.astype(q.dtype)) are the "
+        "point of the rule and never flagged.",
+    ),
+]
 
 _POLICY_DTYPES = {"float32", "bfloat16", "float16"}
 _SCOPE_DIR = "ops/"
@@ -80,3 +99,7 @@ def check_precision_casts(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
             f"'# jaxlint: disable=precision-cast -- <reason>')",
         ))
     return findings
+
+
+CHECK = check_precision_casts
+CROSS_MODULE = False

@@ -45,7 +45,54 @@ from pytorch_distributed_tpu.analysis.core import (
     Finding,
     LintContext,
     ParsedModule,
+    RuleInfo,
 )
+
+RULES = [
+    RuleInfo(
+        "recompile-traced-branch", "error",
+        "Python if/while on a traced argument of a jit-compiled function",
+        "Arguments of a jit-compiled function are tracers: a Python "
+        "if/while on one either raises TracerBoolConversionError or — "
+        "when the argument is marked static — silently compiles once per "
+        "value. Use lax.cond/jnp.where, or mark the argument static and "
+        "accept one compile per value. Closures over builder parameters "
+        "are static at trace time and exempt, as are 'is None' checks "
+        "and isinstance/len-style shape predicates. jit targets are "
+        "found through decorators and the builder idiom "
+        "jax.jit(shard_map(_local_step, ...)).",
+    ),
+    RuleInfo(
+        "recompile-jit-call", "warning",
+        "jax.jit(...)(...) invoked immediately inside a function — the "
+        "compile cache is discarded every call",
+        "jax.jit(f)(x) in one expression inside a function body drops "
+        "the compiled callable (and its cache) on the floor after the "
+        "call, so every call pays a fresh trace+compile. Hoist the jit "
+        "out of the per-call path (module scope or a cached builder).",
+    ),
+    RuleInfo(
+        "recompile-mutable-closure", "warning",
+        "jit-compiled function closes over a module-level mutable that "
+        "the module mutates elsewhere",
+        "jit captures closures at trace time: a module-level list/dict/"
+        "set read inside a jitted function is frozen at the first call, "
+        "so later mutations are silently ignored (stale constants) or, "
+        "for hashable wrappers, retrigger tracing. Pass the value as an "
+        "argument instead.",
+    ),
+    RuleInfo(
+        "recompile-static-argnums", "error",
+        "static_argnums out of range, overlapping donate_argnums, or "
+        "marking a non-hashable (list/dict-default) parameter",
+        "static_argnums indices out of range of the target's signature "
+        "raise at call time; overlap with donate_argnums is "
+        "contradictory (a static argument is part of the jit cache key "
+        "and cannot be donated); a static parameter whose default is a "
+        "non-hashable list/dict/set raises or recompiles on every call "
+        "that uses the default.",
+    ),
+]
 
 _JIT_NAMES = ("jit", "pjit")
 _WRAPPER_NAMES = ("shard_map", "partial", "wraps", "pmap")
@@ -351,3 +398,7 @@ def check_recompile_hazards(mod: ParsedModule, ctx: LintContext) -> List[Finding
                     "out of the per-call path",
                 ))
     return findings
+
+
+CHECK = check_recompile_hazards
+CROSS_MODULE = False

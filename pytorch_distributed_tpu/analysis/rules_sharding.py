@@ -1,0 +1,352 @@
+"""Rule family: sharding — symbolic PartitionSpec checks on shard_map.
+
+``shard_map``'s spec arguments are the SPMD contract: ``in_specs`` says
+how each operand arrives split over the mesh, ``out_specs`` how results
+are reassembled. jax validates them against *array ranks at trace time*,
+but two whole classes of mistake survive until much later or forever:
+
+- an axis name that exists on no mesh ("modle" for "model") raises only
+  when the program is finally traced against a mesh missing it — or, if
+  a mesh somewhere declares the typo'd name, never;
+- an ``in_specs`` tuple whose arity drifted from the wrapped function's
+  signature after a refactor fails at trace time with a pytree error
+  three abstraction layers away from the edit;
+- a ``P()`` entry silently replicates its operand onto every device —
+  correct for tokens and flags, a capacity bug when the operand is the
+  parameter tree or KV pool that sharding exists to split.
+
+This family propagates ``PartitionSpec`` literals symbolically — through
+the ``P`` import alias, ``*_AXIS`` constants, and module-level string
+constants — and checks them against the declared mesh axes
+(``parallel/mesh.py::MESH_AXES`` plus any ``Mesh``/``make_mesh``/
+``pmap`` declaration in the scanned tree):
+
+- ``sharding-unknown-axis`` (error): a spec names an axis no mesh
+  declares.
+- ``sharding-spec-arity`` (error): a literal ``in_specs`` tuple whose
+  length differs from the wrapped function's positional signature, or a
+  literal ``out_specs`` tuple whose length differs from the function's
+  (consistent) tuple-return arity.
+- ``sharding-replicated`` (warning): a literal ``P()`` entry in
+  ``in_specs`` binding a parameter whose name says large carried state
+  (params/state/cache/grads/weights/opt_state/pool/kv) while other
+  operands ARE sharded — the "fell through to full replication" smell.
+
+Specs reached through variables (``self._param_specs``) are opaque and
+skipped, never guessed — the documented false-negative boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pytorch_distributed_tpu.analysis._astutil import (
+    dotted,
+    get_kwarg,
+    import_map,
+    terminal_name,
+)
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ParsedModule,
+    RuleInfo,
+)
+
+RULES = [
+    RuleInfo(
+        "sharding-unknown-axis", "error",
+        "PartitionSpec names a mesh axis no mesh declares",
+        "Every axis name inside a PartitionSpec literal must be declared "
+        "by the mesh: the parallel/mesh.py grid (data/seq/model via the "
+        "*_AXIS constants), a Mesh(axis_names=...)/make_mesh literal, or "
+        "a pmap axis in the scanned tree. A typo'd axis fails only when "
+        "traced against a mesh that happens to miss it — and binds "
+        "silently (to the WRONG axis) when some mesh declares the typo. "
+        "Names are resolved through the P/PartitionSpec import alias, "
+        "*_AXIS constants and module string constants; opaque values "
+        "are skipped.",
+    ),
+    RuleInfo(
+        "sharding-spec-arity", "error",
+        "shard_map in_specs/out_specs arity disagrees with the wrapped "
+        "function",
+        "A literal in_specs tuple must carry exactly one spec per "
+        "positional parameter of the wrapped function, and a literal "
+        "out_specs tuple one spec per element of its (consistent) tuple "
+        "return. Arity drift after a refactor surfaces as a pytree "
+        "structure error at trace time, far from the edit; this check "
+        "moves it to lint time. Functions resolved through the same "
+        "assignment/wrapper chases as the recompile rules; non-literal "
+        "specs and non-tuple returns are skipped.",
+    ),
+    RuleInfo(
+        "sharding-replicated", "warning",
+        "large carried operand falls to P() full replication in a "
+        "sharded program",
+        "A bare P() entry in in_specs replicates its operand onto every "
+        "device of the mesh. That is correct for token ids, flags and "
+        "scalars — and a silent capacity/traffic bug when the operand "
+        "is the parameter tree, optimizer state, or KV pool the mesh "
+        "exists to split: each device holds a full copy and the "
+        "compiler inserts all-gathers nobody asked for. Flagged only "
+        "when the bound parameter's name says large carried state "
+        "(params/state/cache/grads/weights/opt_state/pool/kv) and at "
+        "least one sibling operand IS sharded. Replication that is the "
+        "design (TP-replicated logits) gets an inline suppression with "
+        "its reason.",
+    ),
+]
+
+_LARGE_PARAM_NAMES = {
+    "params", "state", "cache", "grads", "grad", "weights", "opt_state",
+    "pool", "kv",
+}
+
+
+def _spec_ctor_names(mod: ParsedModule) -> Set[str]:
+    """Local names that construct PartitionSpec ('P', 'PartitionSpec')."""
+    out = set()
+    for name, origin in import_map(mod.tree).items():
+        if origin.rsplit(".", 1)[-1] == "PartitionSpec":
+            out.add(name)
+    out.add("PartitionSpec")
+    return out
+
+
+def _module_str_constants(mod: ParsedModule) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = node.value.value
+    return out
+
+
+def _local_declared_axes(mod: ParsedModule) -> Set[str]:
+    axes: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node)
+        if name == "pmap":
+            v = get_kwarg(node, "axis_name")
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                axes.add(v.value)
+        elif name in ("Mesh", "make_mesh"):
+            v = get_kwarg(node, "axis_names")
+            if v is None and name == "Mesh" and len(node.args) > 1:
+                v = node.args[1]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        axes.add(e.value)
+    return axes
+
+
+class _SpecReader:
+    """Resolve axis names out of PartitionSpec literals, symbolically."""
+
+    def __init__(self, mod: ParsedModule, ctx: LintContext):
+        self.ctors = _spec_ctor_names(mod)
+        self.consts = _module_str_constants(mod)
+        self.ctx = ctx
+
+    def is_spec_call(self, node: ast.expr) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id in self.ctors
+        return isinstance(f, ast.Attribute) and f.attr == "PartitionSpec"
+
+    def axis_names(self, spec_call: ast.Call) -> List[Tuple[str, int]]:
+        """(axis name, line) for each resolvable name in the spec; None
+        entries and opaque expressions contribute nothing."""
+        out: List[Tuple[str, int]] = []
+
+        def visit(e: ast.expr):
+            if isinstance(e, ast.Constant):
+                if isinstance(e.value, str):
+                    out.append((e.value, e.lineno))
+            elif isinstance(e, (ast.Tuple, ast.List)):
+                for sub in e.elts:
+                    visit(sub)
+            elif isinstance(e, ast.Name):
+                if e.id in self.consts:
+                    out.append((self.consts[e.id], e.lineno))
+                elif e.id in self.ctx.axis_constants:
+                    out.append((self.ctx.axis_constants[e.id], e.lineno))
+            elif isinstance(e, ast.Attribute):
+                val = self.ctx.axis_constants.get(e.attr)
+                if val is not None:
+                    out.append((val, e.lineno))
+
+        for a in spec_call.args:
+            visit(a)
+        return out
+
+    def is_empty_spec(self, node: ast.expr) -> bool:
+        return (
+            self.is_spec_call(node)
+            and not node.args
+            and not node.keywords
+        )
+
+
+def _chase_target(expr, scopes, depth: int = 0):
+    """Resolve a shard_map's wrapped callable to a local def — the same
+    Name/assignment chase the recompile rules use, minus wrappers."""
+    if depth > 6 or expr is None:
+        return None
+    if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return expr
+    if isinstance(expr, ast.Name):
+        for defs, assigns in reversed(scopes):
+            if expr.id in defs:
+                return defs[expr.id]
+            if expr.id in assigns:
+                return _chase_target(assigns[expr.id], scopes, depth + 1)
+    return None
+
+
+def _positional_arity(fn) -> int:
+    args = fn.args
+    return len(args.posonlyargs) + len(args.args)
+
+
+def _tuple_return_arity(fn) -> Optional[int]:
+    """len(tuple) when every return in ``fn`` (own body, not nested
+    defs) returns a tuple literal of one consistent length."""
+    arity: Optional[int] = None
+    stack: List[ast.AST] = list(fn.body)
+    returns = 0
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        if isinstance(node, ast.Return):
+            returns += 1
+            if not isinstance(node.value, ast.Tuple):
+                return None
+            n = len(node.value.elts)
+            if arity is None:
+                arity = n
+            elif arity != n:
+                return None
+        stack.extend(ast.iter_child_nodes(node))
+    return arity if returns else None
+
+
+def check_sharding(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
+    reader = _SpecReader(mod, ctx)
+    declared = ctx.mesh_axes | _local_declared_axes(mod)
+    findings: List[Finding] = []
+
+    # ---- every PartitionSpec literal: axis names must exist ----
+    for node in ast.walk(mod.tree):
+        if not reader.is_spec_call(node):
+            continue
+        for axis, line in reader.axis_names(node):
+            if axis not in declared:
+                findings.append(Finding(
+                    "sharding-unknown-axis", "error", mod.path, line,
+                    f"PartitionSpec names axis {axis!r}, which no mesh "
+                    f"declares — known axes: {sorted(declared)}",
+                ))
+
+    # ---- shard_map call sites: arity + replication ----
+    def scope_tables(body):
+        defs, assigns = {}, {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, ast.Name):
+                    assigns[t.id] = stmt.value
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, sub.value)
+        return defs, assigns
+
+    def visit(body, scopes):
+        scopes = scopes + [scope_tables(body)]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(node, ast.Call) and terminal_name(node) == "shard_map":
+                    handle(node, scopes)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(stmt.body, scopes)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        visit(sub.body, scopes)
+
+    def handle(call: ast.Call, scopes):
+        target_expr = call.args[0] if call.args else (
+            get_kwarg(call, "f") or get_kwarg(call, "fun")
+        )
+        target = _chase_target(target_expr, scopes)
+        in_specs = get_kwarg(call, "in_specs")
+        out_specs = get_kwarg(call, "out_specs")
+
+        if target is not None and isinstance(in_specs, (ast.Tuple, ast.List)):
+            want = _positional_arity(target)
+            got = len(in_specs.elts)
+            if got != want:
+                findings.append(Finding(
+                    "sharding-spec-arity", "error", mod.path, call.lineno,
+                    f"in_specs has {got} spec(s) but {target.name!r} "
+                    f"takes {want} positional parameter(s) — one spec "
+                    f"per operand, in order",
+                ))
+            else:
+                _check_replication(call, target, in_specs)
+        if target is not None and isinstance(out_specs, (ast.Tuple, ast.List)):
+            ret = _tuple_return_arity(target)
+            got = len(out_specs.elts)
+            if ret is not None and got != ret:
+                findings.append(Finding(
+                    "sharding-spec-arity", "error", mod.path, call.lineno,
+                    f"out_specs has {got} spec(s) but {target.name!r} "
+                    f"returns a {ret}-tuple at every return site",
+                ))
+
+    def _check_replication(call: ast.Call, target, in_specs):
+        params = [a.arg for a in target.args.posonlyargs + target.args.args]
+        any_sharded = any(
+            not reader.is_empty_spec(e) for e in in_specs.elts
+        )
+        if not any_sharded:
+            return
+        for pname, spec in zip(params, in_specs.elts):
+            if not reader.is_empty_spec(spec):
+                continue
+            base = pname.lstrip("_")
+            if base in _LARGE_PARAM_NAMES or any(
+                base.endswith("_" + s) for s in _LARGE_PARAM_NAMES
+            ):
+                findings.append(Finding(
+                    "sharding-replicated", "warning", mod.path, spec.lineno,
+                    f"operand {pname!r} of {target.name!r} falls to P() "
+                    f"full replication while sibling operands are "
+                    f"sharded — every device holds a complete copy; "
+                    f"shard it, or record why replication is the design",
+                ))
+
+    visit(mod.tree.body, [])
+    return findings
+
+
+CHECK = check_sharding
+CROSS_MODULE = False

@@ -1,0 +1,384 @@
+"""Rule family: threads — host-side concurrency hazards.
+
+The device side of this repo is SPMD and deterministic; the HOST side
+has quietly grown a small fleet of concurrent actors: the watchdog
+deadline thread, the background warmup compiler, the Prometheus
+exporter's HTTP threads, the async checkpoint writers, the data-loader
+producer, plus signal handlers (suspend protocol) and the chained
+``sys.excepthook`` (flight recorder). Python's GIL makes many races
+*benign-looking* — right up until a compound check-then-act interleaves.
+TSan doesn't exist for Python; this family is the static stand-in.
+
+The pass first builds a **thread-entry-point inventory** per module
+(``thread_inventory``): every ``threading.Thread(target=...)``, every
+``signal.signal(sig, handler)`` registration, every ``sys.excepthook``
+assignment. Tests and ``--explain`` consume it; two rules check against
+it:
+
+- ``thread-unsynced-mutation`` (warning): inside a class, an attribute
+  mutated from a thread-entry method (or a method transitively reachable
+  from one through ``self.*()`` calls) without any ``with self.<lock>:``
+  held, when the same attribute is also touched by the class's
+  non-thread methods. The classic shapes: a results list appended from
+  the worker and read from ``summary()``, a state flag flipped on both
+  sides of a check-then-act. Deliberate lock-free protocols (monotonic
+  flags, GIL-atomic single stores) stay — with an inline suppression
+  recording WHY they are safe.
+- ``thread-blocking-signal`` (error): a blocking call —
+  ``.block_until_ready()``, ``open()``/file I/O, ``time.sleep``,
+  ``.join()``, ``.acquire()``, ``jax.device_get``, ``subprocess.*`` —
+  inside a registered signal handler. Signal handlers run *between
+  bytecodes on the main thread*, possibly while the interpreter holds
+  the very lock the handler would need: a blocking handler deadlocks
+  the run it was installed to save. Handlers must only latch
+  (``Event.set``, set a flag, chain the previous handler) and return;
+  the suspend protocol's ``_on_signal`` is the reference shape.
+
+Boundaries (documented in ANALYSIS.md): thread targets that are local
+closures or attributes of OTHER objects (``self._server.serve_forever``)
+are inventoried but not analyzed; lock discipline is "some lock held",
+not "the right lock"; cross-module handler registration is invisible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from pytorch_distributed_tpu.analysis._astutil import (
+    dotted,
+    get_kwarg,
+    terminal_name,
+)
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ParsedModule,
+    RuleInfo,
+)
+
+RULES = [
+    RuleInfo(
+        "thread-unsynced-mutation", "warning",
+        "shared attribute mutated from a thread without a lock held",
+        "An attribute written from a threading.Thread target method (or "
+        "a method it reaches through self.*() calls) while the class's "
+        "other methods also read or write it, with no `with self.<lock>:` "
+        "covering the write. The GIL serializes single bytecodes, not "
+        "compound operations: check-then-append, read-modify-write "
+        "(`self.n += 1`) and multi-field updates can interleave with the "
+        "main thread and corrupt or drop state. Hold the class's lock "
+        "around the mutation (the WarmupRunner._records_lock pattern), "
+        "or — for deliberate lock-free protocols like the watchdog's "
+        "monotonic heartbeat flags — suppress inline with the reason "
+        "the race is benign, so the safety argument is recorded next to "
+        "the code it protects.",
+    ),
+    RuleInfo(
+        "thread-blocking-signal", "error",
+        "blocking call inside a registered signal handler",
+        "Signal handlers run between bytecodes on the main thread, "
+        "possibly while the interpreter is inside the allocator, a "
+        "logging lock, or a jax dispatch — any blocking call there "
+        "(.block_until_ready(), open()/file I/O, time.sleep, .join(), "
+        ".acquire(), jax.device_get, subprocess) can deadlock the "
+        "process the handler was installed to save, or block past the "
+        "scheduler's grace window. A handler must only latch state "
+        "(threading.Event.set, a bool flag), optionally chain the "
+        "previous handler, and return; the run's main loop polls the "
+        "latch at a safe point (SuspendWatcher._on_signal is the "
+        "reference shape). Checkpointing belongs on the poll side, "
+        "never in the handler.",
+    ),
+]
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_CONTAINER_MUTATORS = {
+    "append", "extend", "insert", "pop", "update", "clear", "setdefault",
+    "add", "remove", "discard", "popitem",
+}
+_BLOCKING_ATTRS = {"block_until_ready", "join", "acquire", "device_get"}
+_BLOCKING_DOTTED_PREFIXES = ("time.sleep", "subprocess.", "os.system")
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+# ---- the inventory ---------------------------------------------------------
+
+
+def thread_inventory(mod: ParsedModule) -> Dict[str, List[dict]]:
+    """Every concurrency entry point declared in this module.
+
+    ``threads``          [{line, target, kind}] — kind is "self-method",
+                         "function", or "opaque" (attr of another object)
+    ``signal_handlers``  [{line, handler, kind}]
+    ``excepthooks``      [{line, value}] — ``sys.excepthook = ...`` sites
+    """
+    threads: List[dict] = []
+    handlers: List[dict] = []
+    hooks: List[dict] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = terminal_name(node)
+            if name == "Thread":
+                target = get_kwarg(node, "target")
+                threads.append({
+                    "line": node.lineno,
+                    "target": _entry_name(target),
+                    "kind": _entry_kind(target),
+                })
+            elif name == "signal" and isinstance(node.func, ast.Attribute):
+                # signal.signal(sig, handler) — not the bare `signal` module
+                if dotted(node.func) == "signal.signal" and len(node.args) >= 2:
+                    h = node.args[1]
+                    handlers.append({
+                        "line": node.lineno,
+                        "handler": _entry_name(h),
+                        "kind": _entry_kind(h),
+                    })
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if dotted(t) == "sys.excepthook":
+                    hooks.append({
+                        "line": node.lineno,
+                        "value": _entry_name(node.value) or "<expr>",
+                    })
+    return {
+        "threads": threads,
+        "signal_handlers": handlers,
+        "excepthooks": hooks,
+    }
+
+
+def _entry_name(node: Optional[ast.expr]) -> Optional[str]:
+    if node is None:
+        return None
+    d = dotted(node)
+    if d is not None:
+        return d
+    return None
+
+
+def _entry_kind(node: Optional[ast.expr]) -> str:
+    if node is None:
+        return "opaque"
+    if _self_attr(node) is not None:
+        return "self-method"
+    if isinstance(node, ast.Name):
+        return "function"
+    return "opaque"
+
+
+# ---- per-class unsynced-mutation analysis ----------------------------------
+
+
+class _ClassView:
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: Set[str] = set()
+        self.container_attrs: Set[str] = set()
+        for m in self.methods.values():
+            for node in ast.walk(m):
+                if not isinstance(node, ast.Assign):
+                    continue
+                attr = None
+                for t in node.targets:
+                    attr = _self_attr(t) or attr
+                if attr is None:
+                    continue
+                v = node.value
+                if isinstance(v, ast.Call) and terminal_name(v) in _LOCK_CTORS:
+                    self.lock_attrs.add(attr)
+                elif isinstance(v, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(v, ast.Call)
+                    and terminal_name(v) in ("list", "dict", "set", "deque")
+                ):
+                    self.container_attrs.add(attr)
+
+    def thread_entry_methods(self) -> Set[str]:
+        """Methods handed to threading.Thread(target=self.X) anywhere in
+        this class, plus everything they reach via self.Y() calls."""
+        roots: Set[str] = set()
+        for m in self.methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call) and terminal_name(node) == "Thread":
+                    attr = _self_attr(get_kwarg(node, "target"))
+                    if attr is not None and attr in self.methods:
+                        roots.add(attr)
+        # transitive closure over self-method calls AND self-method
+        # references (callbacks handed to retry/executor helpers run in
+        # the same thread context as the method that passes them)
+        frontier = list(roots)
+        while frontier:
+            name = frontier.pop()
+            for node in ast.walk(self.methods[name]):
+                callee = None
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                elif isinstance(node, ast.Attribute):
+                    callee = _self_attr(node)
+                if callee in self.methods and callee not in roots:
+                    roots.add(callee)
+                    frontier.append(callee)
+        return roots
+
+    def attr_access_map(self) -> Dict[str, Set[str]]:
+        """self-attr name -> method names touching it (read or write)."""
+        out: Dict[str, Set[str]] = {}
+        for name, m in self.methods.items():
+            for node in ast.walk(m):
+                attr = _self_attr(node) if isinstance(node, ast.Attribute) else None
+                if attr is not None:
+                    out.setdefault(attr, set()).add(name)
+        return out
+
+    def mutations_in(self, method: ast.FunctionDef):
+        """(attr, line, locked) for every self-attr mutation in the
+        method, with ``locked`` True when under any `with self.<lock>:`."""
+        out: List[Tuple[str, int, bool]] = []
+
+        def visit(node: ast.AST, locked: bool):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                holds = locked or any(
+                    (attr := _self_attr(item.context_expr)) is not None
+                    and attr in self.lock_attrs
+                    for item in node.items
+                )
+                for sub in node.body:
+                    visit(sub, holds)
+                return
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        out.append((attr, node.lineno, locked))
+                    elif isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr is not None:
+                            out.append((attr, node.lineno, locked))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                attr = _self_attr(node.target)
+                if attr is not None:
+                    out.append((attr, node.lineno, locked))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _CONTAINER_MUTATORS
+                ):
+                    attr = _self_attr(f.value)
+                    if attr is not None and attr in self.container_attrs:
+                        out.append((attr, node.lineno, locked))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                     ast.ClassDef),
+                ):
+                    continue
+                visit(child, locked)
+
+        for stmt in method.body:
+            visit(stmt, False)
+        return out
+
+
+def _check_class(view: _ClassView, mod: ParsedModule,
+                 findings: List[Finding]) -> None:
+    entries = view.thread_entry_methods()
+    if not entries:
+        return
+    access = view.attr_access_map()
+    for name in sorted(entries):
+        method = view.methods[name]
+        for attr, line, locked in view.mutations_in(method):
+            if locked or attr in view.lock_attrs:
+                continue
+            # __init__ runs before any Thread exists: its accesses are
+            # happens-before the thread by construction, never shared
+            outside = access.get(attr, set()) - entries - {"__init__"}
+            if not outside:
+                continue  # touched only by thread-side methods
+            findings.append(Finding(
+                "thread-unsynced-mutation", "warning", mod.path, line,
+                f"{view.cls.name}.{name} runs on a thread "
+                f"(threading.Thread target) and mutates self.{attr} "
+                f"with no lock held, while "
+                f"{sorted(outside)} also touch it — guard it with the "
+                f"class lock, or record why the race is benign",
+            ))
+
+
+# ---- signal handlers -------------------------------------------------------
+
+
+def _blocking_calls(fn: ast.FunctionDef) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "open":
+            out.append((node.lineno, "open() — file I/O"))
+        elif isinstance(f, ast.Attribute):
+            if f.attr in _BLOCKING_ATTRS:
+                out.append((node.lineno, f".{f.attr}()"))
+            else:
+                d = dotted(f)
+                if d is not None and any(
+                    d == p or d.startswith(p) for p in _BLOCKING_DOTTED_PREFIXES
+                ):
+                    out.append((node.lineno, f"{d}()"))
+    return out
+
+
+def _check_signal_handlers(mod: ParsedModule, findings: List[Finding]) -> None:
+    inv = thread_inventory(mod)
+    if not inv["signal_handlers"]:
+        return
+    # resolve handler names to defs: module-level functions and methods
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    for h in inv["signal_handlers"]:
+        name = h["handler"]
+        if name is None:
+            continue
+        tail = name.rsplit(".", 1)[-1]  # self._on_signal -> _on_signal
+        fn = defs.get(tail)
+        if fn is None:
+            continue
+        for line, desc in _blocking_calls(fn):
+            findings.append(Finding(
+                "thread-blocking-signal", "error", mod.path, line,
+                f"{desc} inside signal handler {fn.name!r} (registered "
+                f"at line {h['line']}): handlers run between bytecodes "
+                f"on the main thread and must only latch a flag and "
+                f"return — move the blocking work to the poll side",
+            ))
+
+
+def check_threads(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            _check_class(_ClassView(node), mod, findings)
+    _check_signal_handlers(mod, findings)
+    return findings
+
+
+CHECK = check_threads
+CROSS_MODULE = False

@@ -1,0 +1,123 @@
+"""SARIF 2.1.0 emission for jaxlint findings.
+
+SARIF (Static Analysis Results Interchange Format) is the lingua franca
+of CI annotation surfaces — GitHub code scanning, GitLab SAST, VS Code's
+SARIF viewer all ingest it directly, so one artifact turns jaxlint
+findings into inline PR annotations with zero glue code. The emitter
+maps:
+
+- each catalogue rule -> ``tool.driver.rules[]`` (id, short/full
+  description, default severity level);
+- each finding -> ``results[]`` with the repo-relative artifact
+  location, 1-based region, and the finding's stable content-derived
+  fingerprint under ``partialFingerprints`` — the key CI services use
+  to track a finding across commits even as line numbers shift (the
+  same content-not-line-number contract as the text baseline).
+
+Pure stdlib, no jax; validated structurally by tests/test_jaxlint_v2.py.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    RuleInfo,
+    rule_catalog,
+)
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+_TOOL_VERSION = "2.0.0"
+
+_LEVELS = {"error": "error", "warning": "warning"}
+
+
+def _rule_descriptor(info: RuleInfo) -> dict:
+    return {
+        "id": info.rule,
+        "shortDescription": {"text": info.short},
+        "fullDescription": {"text": info.explain},
+        "defaultConfiguration": {
+            "level": _LEVELS.get(info.severity, "warning")
+        },
+        "helpUri": (
+            "https://example.invalid/jaxlint#" + info.rule
+        ),
+    }
+
+
+def to_sarif(
+    findings: Sequence[Finding],
+    baselined: Sequence[Finding] = (),
+    catalog: Optional[Sequence[RuleInfo]] = None,
+) -> dict:
+    """One SARIF run. ``findings`` become normal results; ``baselined``
+    ones are included with ``baselineState: "unchanged"`` so a CI viewer
+    shows the full picture while its gate keys only on new results."""
+    catalog = list(catalog) if catalog is not None else rule_catalog()
+    rules = [_rule_descriptor(r) for r in catalog]
+    index: Dict[str, int] = {r["id"]: i for i, r in enumerate(rules)}
+
+    def result(f: Finding, baseline_state: Optional[str]) -> dict:
+        out = {
+            "ruleId": f.rule,
+            "level": _LEVELS.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        }
+        if f.rule in index:
+            out["ruleIndex"] = index[f.rule]
+        if f.fingerprint:
+            out["partialFingerprints"] = {
+                "jaxlintFingerprint/v1": f.fingerprint
+            }
+        if baseline_state is not None:
+            out["baselineState"] = baseline_state
+        return out
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "jaxlint",
+                    "version": _TOOL_VERSION,
+                    "informationUri": "ANALYSIS.md",
+                    "rules": rules,
+                },
+            },
+            "originalUriBaseIds": {
+                "SRCROOT": {"description": {"text": "repository root"}},
+            },
+            "results": (
+                [result(f, None) for f in findings]
+                + [result(f, "unchanged") for f in baselined]
+            ),
+        }],
+    }
+
+
+def write_sarif(
+    path: str,
+    findings: Sequence[Finding],
+    baselined: Sequence[Finding] = (),
+) -> None:
+    doc = to_sarif(findings, baselined)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")

@@ -23,6 +23,8 @@ largest row is ~1.6 MB — VMEM-comfortable), accumulate in fp32, and run
 in interpret mode off-TPU so CPU tests execute the same code path.
 """
 
+# jaxlint: disable-file=precision-cast -- Pallas reduction scratch accumulates in fp32 regardless of io dtype; the casts feed those accumulators (burned down from the lint baseline, PR 9)
+
 from __future__ import annotations
 
 import functools

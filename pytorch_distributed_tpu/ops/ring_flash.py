@@ -28,12 +28,20 @@ them naively would need an lse-cotangent rule the kernel doesn't define):
   and home (one collective permutation per step, same overlap story as
   the forward).
 
+The whole file accumulates in fp32 by construction — the ring merge state
+(m, l, acc) and the travelling dq/dk/dv accumulators exist to keep bf16
+block results exact across shards; every ``.astype(jnp.float32)`` here IS
+the numerics contract, not a policy override (burned down from the lint
+baseline into the file-level suppression below, PR 9).
+
 Exactness: values match ``ring_attention``/dense to fp accumulation order;
 gradients match dense attention's (tests/test_ring_flash.py, values and
 all three grads). Requires equal-length shards with L_local a multiple of
 the block sizes (the LM's standard configuration); anything else should
 use ``ring_attention``.
 """
+
+# jaxlint: disable-file=precision-cast -- ring kernel accumulators (o/dq/dk/dv, LSE merge state) are fp32 by construction; every cast merges bf16 block results into them
 
 from __future__ import annotations
 

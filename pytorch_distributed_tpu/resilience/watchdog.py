@@ -159,7 +159,7 @@ class Watchdog:
                 stall_at = None
                 continue
             if not self._fired:
-                self._fired = True
+                self._fired = True  # jaxlint: disable=thread-unsynced-mutation -- deliberate lock-free monotonic flag: single GIL-atomic bool store; beat() clearing it concurrently at worst re-arms one extra dump
                 self.stalls += 1
                 stall_at = time.monotonic()
                 self._handle_stall(stalled)

@@ -465,7 +465,7 @@ class _ShardedSave:
         try:
             self.write()
         except BaseException as e:  # surfaced at finalize()
-            self._write_err = e
+            self._write_err = e  # jaxlint: disable=thread-unsynced-mutation -- single-owner handoff: finalize() joins the writer thread before reading, so the store happens-before the only read
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._write_guarded,

@@ -5,9 +5,17 @@
 # nor recorded with a reason in scripts/jaxlint_baseline.json — so NEW
 # hazards fail the build while the reviewed pre-existing ones don't.
 #
-# Usage: scripts/ci_check.sh [--lint-only|--resilience-smoke|--serving-smoke|
+# Usage: scripts/ci_check.sh [--lint-only|--lint-incremental|
+#                             --resilience-smoke|--serving-smoke|
 #                             --telemetry-smoke|--warmup-smoke|--reshard-smoke|
 #                             --fleet-smoke|--obs-smoke|--bench-regression]
+#
+# --lint-incremental: jaxlint via the content-hash cache
+# (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
+# rules re-run on any change; the cheap per-commit gate. The full run
+# (every other mode) stays the default and carries a 30 s timing budget
+# plus a SARIF 2.1.0 artifact at output/jaxlint.sarif for CI annotation
+# surfaces.
 #
 # --resilience-smoke: lint, then ONE crash-recovery cycle from the
 # kill-matrix (SIGKILL mid-shard-write → relaunch → assert resume) —
@@ -64,8 +72,17 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== jaxlint =="
-JAX_PLATFORMS=cpu python scripts/jaxlint.py pytorch_distributed_tpu/
+if [[ "${1:-}" == "--lint-incremental" ]]; then
+    echo "== jaxlint (incremental, content-hash cache) =="
+    JAX_PLATFORMS=cpu python scripts/jaxlint.py --incremental \
+        pytorch_distributed_tpu/
+    exit 0
+fi
+
+echo "== jaxlint (full tree, 30s budget, SARIF artifact) =="
+mkdir -p output
+JAX_PLATFORMS=cpu python scripts/jaxlint.py pytorch_distributed_tpu/ \
+    --sarif-out output/jaxlint.sarif --max-seconds 30
 
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0

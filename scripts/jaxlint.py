@@ -3,16 +3,27 @@
 
     python scripts/jaxlint.py pytorch_distributed_tpu/
     python scripts/jaxlint.py --list-rules
+    python scripts/jaxlint.py --explain donation-use-after-donate
+    python scripts/jaxlint.py --incremental pytorch_distributed_tpu/
+    python scripts/jaxlint.py --sarif-out output/jaxlint.sarif pytorch_distributed_tpu/
+    python scripts/jaxlint.py --fix-baseline pytorch_distributed_tpu/
     python scripts/jaxlint.py --no-baseline tests/fixtures/jaxlint/
 
-Exit codes: 0 no new findings; 1 new findings; 2 usage/internal error.
+Exit codes: 0 no new findings; 1 new findings; 2 usage/internal error;
+3 the --max-seconds budget was exceeded (findings notwithstanding).
 
 Pre-existing, reviewed findings live in scripts/jaxlint_baseline.json
 (each with a reason) and don't fail the run; anything NOT in the baseline
-does. The partition-coverage check needs an importable jax and is skipped
-with a notice when that fails (e.g. a docs-only CI container).
+does. --fix-baseline regenerates that file from the current findings in
+deterministic order, preserving reasons and dropping fixed entries — the
+baseline only ever shrinks. --incremental serves unchanged files from a
+content-hash cache (cross-module rules still re-run on any change). The
+partition-coverage check needs an importable jax and is skipped with a
+notice when that fails (e.g. a docs-only CI container).
 
-Rules, severities and the suppression syntax are documented in ANALYSIS.md.
+Rules and the suppression syntax are documented in ANALYSIS.md; the
+long-form text behind --explain lives next to each rule's implementation
+(``RuleInfo``), so the two cannot drift.
 """
 
 from __future__ import annotations
@@ -21,18 +32,25 @@ import argparse
 import json
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from pytorch_distributed_tpu.analysis import (  # noqa: E402
     all_rule_ids,
+    explain_rule,
     load_baseline,
+    regenerate_baseline,
     run_lint,
+    run_lint_incremental,
     split_baselined,
+    with_fingerprints,
+    write_sarif,
 )
 
 DEFAULT_BASELINE = os.path.join(REPO, "scripts", "jaxlint_baseline.json")
+DEFAULT_CACHE = os.path.join(REPO, ".jaxlint_cache.json")
 
 
 def main(argv=None) -> int:
@@ -45,22 +63,58 @@ def main(argv=None) -> int:
                     help="baseline JSON of reviewed findings")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, baseline ignored")
+    ap.add_argument("--fix-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "(deterministic order, reasons preserved) and exit 0")
     ap.add_argument("--no-partition-coverage", action="store_true",
                     help="skip the runtime partition-rule coverage check")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--incremental", action="store_true",
+                    help="serve unchanged files from the content-hash cache")
+    ap.add_argument("--cache", default=DEFAULT_CACHE,
+                    help="incremental cache file (gitignored)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--sarif-out", metavar="FILE",
+                    help="also write a SARIF 2.1.0 artifact to FILE")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    help="fail (exit 3) when the lint wall time exceeds "
+                         "this budget — the CI timing gate")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="RULE_ID",
+                    help="print one rule's long-form documentation")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rule, severity, desc in all_rule_ids():
             print(f"{rule:32} {severity:8} {desc}")
         return 0
+    if args.explain:
+        text = explain_rule(args.explain)
+        if text is None:
+            known = ", ".join(r for r, _s, _d in all_rule_ids())
+            print(f"jaxlint: unknown rule {args.explain!r} — known rules: "
+                  f"{known}", file=sys.stderr)
+            return 2
+        print(text)
+        return 0
     if not args.paths:
         ap.print_usage(sys.stderr)
         print("jaxlint: error: no paths given", file=sys.stderr)
         return 2
 
-    findings = run_lint(args.paths, rel_root=REPO)
+    t0 = time.perf_counter()
+
+    if args.incremental:
+        inc = run_lint_incremental(args.paths, args.cache, rel_root=REPO)
+        findings = inc.findings
+        print(
+            f"jaxlint: incremental — {inc.linted} file(s) linted, "
+            f"{inc.cached} served from cache"
+            + (" (context changed: full pass)" if inc.full_run else ""),
+            file=sys.stderr,
+        )
+    else:
+        findings = run_lint(args.paths, rel_root=REPO)
 
     lint_package = any(
         os.path.abspath(p).startswith(
@@ -80,18 +134,48 @@ def main(argv=None) -> int:
             print(f"jaxlint: partition-coverage skipped (no jax: {e})",
                   file=sys.stderr)
 
-    entries = []
-    if not args.no_baseline and os.path.exists(args.baseline):
-        entries = load_baseline(args.baseline)
     sources = {}
     for p in {f.path for f in findings}:
         ap_path = os.path.join(REPO, p)
         if os.path.exists(ap_path):
             with open(ap_path, "r", encoding="utf-8") as fh:
                 sources[p] = fh.read().splitlines()
+    # runtime-rule findings (partition coverage) arrive unfingerprinted
+    findings = with_fingerprints(findings, sources)
+
+    entries = []
+    if not args.no_baseline and os.path.exists(args.baseline):
+        entries = load_baseline(args.baseline)
+
+    if args.fix_baseline:
+        doc = regenerate_baseline(findings, entries, sources)
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        n = len(doc["findings"])
+        unreviewed = sum(
+            1 for e in doc["findings"] if e["reason"].startswith("UNREVIEWED")
+        )
+        print(
+            f"jaxlint: baseline regenerated — {n} entr"
+            f"{'y' if n == 1 else 'ies'} ({len(entries)} before, "
+            f"{unreviewed} UNREVIEWED need a reason or a fix): "
+            f"{os.path.relpath(args.baseline, REPO)}"
+        )
+        return 0
+
     new, baselined = split_baselined(findings, entries, sources)
 
-    if args.format == "json":
+    if args.sarif_out:
+        os.makedirs(os.path.dirname(args.sarif_out) or ".", exist_ok=True)
+        write_sarif(args.sarif_out, new, baselined)
+        print(f"jaxlint: SARIF written to {args.sarif_out}", file=sys.stderr)
+
+    if args.format == "sarif":
+        from pytorch_distributed_tpu.analysis import to_sarif
+
+        print(json.dumps(to_sarif(new, baselined), indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "new": [vars(f) for f in new],
             "baselined": [vars(f) for f in baselined],
@@ -107,6 +191,15 @@ def main(argv=None) -> int:
             + ("" if args.no_baseline or not os.path.exists(args.baseline)
                else f" [{os.path.relpath(args.baseline, REPO)}]")
         )
+
+    elapsed = time.perf_counter() - t0
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(
+            f"jaxlint: wall time {elapsed:.1f}s exceeded the "
+            f"--max-seconds {args.max_seconds:.1f}s budget",
+            file=sys.stderr,
+        )
+        return 3
     return 1 if new else 0
 
 

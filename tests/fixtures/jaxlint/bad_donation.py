@@ -1,0 +1,76 @@
+"""Seeded donation violations with EXPECT markers — the dataflow pass's
+ground truth. Never imported, only parsed."""
+
+import jax
+
+
+def make_push():
+    def _push(buf, idx, row):
+        return buf.at[idx].set(row), idx + 1
+
+    return jax.jit(_push, donate_argnums=(0, 1))
+
+
+def use_after_donate(buf, idx, row):
+    push = make_push()
+    out, nidx = push(buf, idx, row)
+    total = buf.sum()  # EXPECT: donation-use-after-donate
+    return out, nidx, total
+
+
+def use_after_donate_branchless(buf, idx, row):
+    push = make_push()
+    if idx is None:
+        out, nidx = push(buf, idx, row)
+        return out, nidx
+    # different branch: reading buf here is fine (no donate on this path)
+    return buf.sum(), idx
+
+
+def double_donation(buf, row):
+    combine = jax.jit(lambda a, b, r: a + b + r, donate_argnums=(0,))
+    return combine(buf, buf, row)  # EXPECT: donation-alias
+
+
+def loop_never_rebinds(state, batches):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    out = None
+    for b in batches:
+        out = step(state, b)  # EXPECT: donation-use-after-donate
+    return out
+
+
+def hot_loop_no_donation(state, batches):
+    step = jax.jit(lambda s, b: s + b)
+    for b in batches:
+        state = step(state, b)  # EXPECT: donation-none-hot-loop
+    return state
+
+
+class Engine:
+    """The builder/attr idioms the serving engine uses, done wrong."""
+
+    def __init__(self, cache, logits):
+        self.cache = cache
+        self.logits = logits
+        self._tick = jax.jit(lambda c: c * 2, donate_argnums=(0,))
+
+    def _decode_fn(self):
+        fn = jax.jit(lambda c, lg: (c, lg), donate_argnums=(0, 1))
+        return fn
+
+    def tick_then_read(self):
+        new = self._tick(self.cache)
+        stale = self.cache.sum()  # EXPECT: donation-use-after-donate
+        self.cache = new
+        return stale
+
+    def chained_builder_wrong(self):
+        out_c, out_l = self._decode_fn()(self.cache, self.logits)
+        self.cache = out_c
+        return self.logits  # EXPECT: donation-use-after-donate
+
+    def tick_right(self):
+        # the correct idiom: rebind from the result in the same statement
+        self.cache = self._tick(self.cache)
+        return self.cache

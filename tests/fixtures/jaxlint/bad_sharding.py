@@ -1,0 +1,59 @@
+"""Seeded shard_map/PartitionSpec violations with EXPECT markers.
+Never imported, only parsed."""
+
+from jax.sharding import PartitionSpec as P
+
+from jax.experimental.shard_map import shard_map
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def axis_typo():
+    return P("modle")  # EXPECT: sharding-unknown-axis
+
+
+def axis_typo_nested():
+    return P(("data", "sq"), None)  # EXPECT: sharding-unknown-axis
+
+
+def make_bad_in_arity(mesh):
+    def _local(xs, batch):
+        return xs, batch
+
+    sharded = shard_map(  # EXPECT: sharding-spec-arity
+        _local,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P()),
+        out_specs=(P(), P(DATA_AXIS)),
+    )
+    return sharded
+
+
+def make_bad_out_arity(mesh):
+    def _local(xs, batch):
+        return xs, batch
+
+    sharded = shard_map(  # EXPECT: sharding-spec-arity
+        _local,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS), P()),
+    )
+    return sharded
+
+
+def make_replicated_params(mesh):
+    def _fwd(params, batch):
+        return batch
+
+    sharded = shard_map(
+        _fwd,
+        mesh=mesh,
+        in_specs=(
+            P(),  # EXPECT: sharding-replicated
+            P(DATA_AXIS),
+        ),
+        out_specs=P(DATA_AXIS),
+    )
+    return sharded

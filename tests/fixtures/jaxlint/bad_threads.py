@@ -1,0 +1,42 @@
+"""Seeded host-concurrency violations with EXPECT markers.
+Never imported, only parsed."""
+
+import signal
+import threading
+import time
+
+
+class Worker:
+    """Thread-target method mutating shared attrs with no lock held."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.results = []
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self.results.append(1)  # EXPECT: thread-unsynced-mutation
+        self.count += 1  # EXPECT: thread-unsynced-mutation
+        self._locked_push()
+
+    def _locked_push(self):
+        # reachable from the thread, but correctly guarded: no finding
+        with self._lock:
+            self.results.append(2)
+
+    def summary(self):
+        return len(self.results), self.count
+
+
+def _blocking_handler(signum, frame):
+    with open("/tmp/dump.json", "w") as f:  # EXPECT: thread-blocking-signal
+        f.write("{}")
+    time.sleep(0.5)  # EXPECT: thread-blocking-signal
+
+
+signal.signal(signal.SIGTERM, _blocking_handler)

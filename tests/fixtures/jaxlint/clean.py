@@ -15,7 +15,7 @@ SEQ_AXIS = "seq"
 
 def psum_tree(tree, axis=DATA_AXIS):
     # axis via shared constant, resolvable through the parameter default
-    return jax.lax.psum(tree, axis_name=axis)
+    return jax.lax.psum(tree, axis_name=axis)  # CLEAN: collective-axis, collective-axis-literal
 
 
 def combined(tree):
@@ -26,7 +26,7 @@ def combined(tree):
 def consistent(grads, metrics):
     # same operand, same axis at both sites
     grads = jax.lax.pmean(grads, DATA_AXIS)
-    grads = jax.lax.pmean(grads, DATA_AXIS)
+    grads = jax.lax.pmean(grads, DATA_AXIS)  # CLEAN: collective-axis-inconsistent
     metrics = jax.lax.psum(metrics, (DATA_AXIS, SEQ_AXIS))
     return grads, metrics
 
@@ -34,7 +34,7 @@ def consistent(grads, metrics):
 def make_step(label_smoothing=0.0):
     # the builder idiom: closures may drive Python control flow freely
     def _local_step(state, batch):
-        if label_smoothing:  # closure, not a traced argument
+        if label_smoothing:  # closure, not a traced argument  # CLEAN: recompile-traced-branch
             pass
         loss = jnp.mean(batch)
         return jax.lax.pmean(loss, DATA_AXIS), state
@@ -42,7 +42,7 @@ def make_step(label_smoothing=0.0):
     return jax.jit(_local_step, donate_argnums=(0,))
 
 
-@partial(jax.jit, static_argnums=(1,))
+@partial(jax.jit, static_argnums=(1,))  # CLEAN: recompile-static-argnums
 def scaled(x, factor=2):
     # static argument legitimately branches: it is a Python value
     if factor > 1:
@@ -55,4 +55,4 @@ _COMPILED = jax.jit(lambda x: x + 1)
 
 def hot_loop(xs):
     # jit built once at module scope, reused per call: no rebuild cost
-    return [_COMPILED(x) for x in xs]
+    return [_COMPILED(x) for x in xs]  # CLEAN: recompile-jit-call

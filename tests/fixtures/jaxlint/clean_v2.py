@@ -1,0 +1,118 @@
+"""Clean fixture for the v2 rule families: every donation/sharding/
+threading pattern the rules police, done right. The fixture test asserts
+jaxlint reports ZERO findings here — guarding against false positives —
+and the meta-test requires every rule id to appear on a CLEAN marker
+somewhere, proving a correct-usage example exists for each rule.
+Never imported, only parsed."""
+
+import signal
+import threading
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from jax.experimental.shard_map import shard_map
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_TABLE = [1, 2, 3]  # module-level container, never mutated: safe to close over
+
+
+@jax.jit
+def lookup(x):
+    return x + _TABLE[0]  # CLEAN: recompile-mutable-closure
+
+
+# ---- donation: the rebind-from-result idiom --------------------------------
+
+
+def good_rebind(state, batch):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    state = step(state, batch)  # CLEAN: donation-use-after-donate
+    return state.sum()
+
+
+def good_loop_carry(state, batches):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    for b in batches:
+        state = step(state, b)  # CLEAN: donation-none-hot-loop
+    return state
+
+
+def good_distinct_buffers(buf_a, buf_b, row):
+    combine = jax.jit(lambda a, b, r: a + b + r, donate_argnums=(0,))
+    return combine(buf_a, buf_b, row)  # CLEAN: donation-alias
+
+
+class GoodEngine:
+    def __init__(self, cache, logits):
+        self.cache = cache
+        self.logits = logits
+        self._tick = jax.jit(lambda c, lg: (c * 2, lg), donate_argnums=(0, 1))
+
+    def tick(self):
+        # donated attrs rebound from the result in the same statement
+        self.cache, self.logits = self._tick(self.cache, self.logits)
+        return self.logits
+
+
+# ---- sharding: specs that match the mesh and the signature -----------------
+
+
+def make_good_specs(mesh):
+    def _fwd(params, batch):
+        return params, batch
+
+    sharded = shard_map(  # CLEAN: sharding-spec-arity
+        _fwd,
+        mesh=mesh,
+        in_specs=(P(MODEL_AXIS), P(DATA_AXIS)),  # CLEAN: sharding-unknown-axis, sharding-replicated
+        out_specs=(P(MODEL_AXIS), P(DATA_AXIS)),
+    )
+    return sharded
+
+
+def make_replicated_tokens(mesh):
+    # P() on small host-built operands (token ids) is the design, not a bug
+    def _fwd(params, tokens):
+        return tokens
+
+    return shard_map(
+        _fwd,
+        mesh=mesh,
+        in_specs=(P(MODEL_AXIS), P()),
+        out_specs=P(),
+    )
+
+
+# ---- threads: lock discipline and latch-only signal handlers ---------------
+
+
+class GoodWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.results = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self.results.append(1)  # CLEAN: thread-unsynced-mutation
+
+    def summary(self):
+        with self._lock:
+            return list(self.results)
+
+
+_SUSPEND = threading.Event()
+
+
+def _latch_handler(signum, frame):
+    _SUSPEND.set()  # CLEAN: thread-blocking-signal
+
+
+signal.signal(signal.SIGTERM, _latch_handler)

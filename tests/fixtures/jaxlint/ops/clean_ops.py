@@ -1,0 +1,11 @@
+"""Clean ops/ fixture: dtype decisions routed through the policy — the
+precision-cast rule must stay silent. Never imported, only parsed."""
+
+
+def policy_cast(x, policy):
+    # the policy owns the dtype: no literal cast, nothing to flag
+    return x.astype(policy.compute_dtype)  # CLEAN: precision-cast
+
+
+def peer_cast(q, k):
+    return k.astype(q.dtype)  # CLEAN: precision-cast

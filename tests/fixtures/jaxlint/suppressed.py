@@ -1,8 +1,16 @@
 """Every violation here carries a suppression comment; the fixture test
 asserts jaxlint reports ZERO findings — proving the suppression syntax
-works for each rule. Parsed by tests, never imported."""
+works for each rule (including every v2 family). Parsed by tests, never
+imported."""
+
+import signal
+import threading
+import time
 
 import jax
+from jax.sharding import PartitionSpec as P
+
+from jax.experimental.shard_map import shard_map
 
 DATA_AXIS = "data"
 
@@ -21,3 +29,78 @@ def reviewed_branch(x, n):
     if n > 0:  # jaxlint: disable=recompile-traced-branch -- n is static at every call site; one compile per n is intended
         return x * n
     return x
+
+
+# ---- v2 families -----------------------------------------------------------
+
+
+def reviewed_use_after_donate(state, batch):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    out = step(state, batch)
+    return out, state.sum()  # jaxlint: disable=donation-use-after-donate -- CPU-only diagnostic helper; the backend copies donated buffers
+
+
+def reviewed_alias(buf, row):
+    combine = jax.jit(lambda a, b, r: a + b + r, donate_argnums=(0,))
+    return combine(buf, buf, row)  # jaxlint: disable=donation-alias -- doc example demonstrating the hazard
+
+
+def reviewed_undonated_loop(state, batches):
+    step = jax.jit(lambda s, b: s + b)
+    for b in batches:
+        state = step(state, b)  # jaxlint: disable=donation-none-hot-loop -- toy carry in a test helper; donation churn is noise at this size
+    return state
+
+
+def reviewed_external_axis_spec():
+    return P("replica")  # jaxlint: disable=sharding-unknown-axis -- external launcher mesh declares this axis
+
+
+def make_reviewed_arity(mesh):
+    def _local(xs, batch):
+        return xs, batch
+
+    return shard_map(  # jaxlint: disable=sharding-spec-arity -- doc example; the extra spec is the point being illustrated
+        _local,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P()),
+        out_specs=(P(), P(DATA_AXIS)),
+    )
+
+
+def make_reviewed_replicated(mesh):
+    def _fwd(params, batch):
+        return batch
+
+    return shard_map(
+        _fwd,
+        mesh=mesh,
+        in_specs=(
+            P(),  # jaxlint: disable=sharding-replicated -- tiny eval head; replication is cheaper than the gather
+            P(DATA_AXIS),
+        ),
+        out_specs=P(DATA_AXIS),
+    )
+
+
+class ReviewedLatch:
+    def __init__(self):
+        self.flag = False
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self.flag = True  # jaxlint: disable=thread-unsynced-mutation -- monotonic bool latch: single GIL-atomic store, readers only poll
+
+    def poll(self):
+        return self.flag
+
+
+def _reviewed_handler(signum, frame):
+    time.sleep(0.01)  # jaxlint: disable=thread-blocking-signal -- test-only handler on a dedicated diagnostic signal
+
+
+signal.signal(signal.SIGUSR2, _reviewed_handler)

@@ -1,0 +1,21 @@
+"""Clean train/ fixture: a compiled step body with no host syncs — the
+host-transfer call-graph walk must stay silent. Never imported, only
+parsed."""
+
+import jax
+import jax.numpy as jnp
+
+DATA_AXIS = "data"
+
+
+def _pure_helper(batch):
+    # device-side math only: reachable from the step, nothing to flag
+    return jnp.mean(batch)  # CLEAN: host-transfer
+
+
+def make_train_step():
+    def _local_step(state, batch):
+        loss = _pure_helper(batch)
+        return state, jax.lax.pmean(loss, DATA_AXIS)
+
+    return jax.jit(_local_step, donate_argnums=(0,))
