@@ -242,6 +242,11 @@ def serving_registry(engine, extra: Iterable = ()) -> ProgramRegistry:
                 f"chunk={engine.chunk}",
                 f"temperature={engine.temperature}",
                 f"top_k={engine.top_k}",
+                # program-shape variants (ISSUE 10): the gather spelling
+                # rides in via the config repr (gather_impl field); the
+                # pool quantization changes every program's cache avals,
+                # so artifacts must not be interchangeable across it
+                f"kv_dtype={getattr(engine, 'kv_dtype', None)}",
                 *extra,
             ),
         )

@@ -100,6 +100,16 @@ class TransformerConfig:
     moe_top_k: int = 1  # 1 = Switch, 2 = GShard top-2
     expert_axis: Optional[str] = None
     ep_size: int = 1
+    # Paged-serving KV gather spelling (ops.attention.paged_attention):
+    # "dense" = jnp.take-over-blocks (gathered KV materializes in HBM),
+    # "pallas" = the fused block-gather kernel (ops/paged_flash.py —
+    # block tables read by BlockSpec index maps, online softmax in VMEM;
+    # interpret mode off-TPU). Only the block_tables= serving path reads
+    # it; training/dense-decode configs ignore it. Serving constructors
+    # (PagedEngine/Scheduler/ContinuousBatcher gather_impl=) replace it
+    # into the config, which also folds it into the registry run
+    # fingerprint.
+    gather_impl: str = "dense"
 
     def __post_init__(self):
         if self.ring_layout not in ("contiguous", "zigzag"):
@@ -181,6 +191,11 @@ class TransformerConfig:
             )
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(f"dropout must be in [0, 1), got {self.dropout}")
+        if self.gather_impl not in ("dense", "pallas"):
+            raise ValueError(
+                f"gather_impl {self.gather_impl!r} must be 'dense' or "
+                "'pallas' (ops.attention.paged_attention spellings)"
+            )
 
     def uses_vocab_parallel(self) -> bool:
         """THE vocab-parallel predicate — the one place the condition
@@ -310,13 +325,53 @@ class Attention(nn.Module):
             # request (each owns its blocks); the engine routes inactive
             # slots' writes to the trash block, where duplicate hits are
             # harmless garbage.
-            ck.value = ck.value.at[blk.reshape(-1), off.reshape(-1)].set(
-                k.astype(cfg.dtype).reshape(b * l, kv_heads, head_dim)
-            )
-            cv.value = cv.value.at[blk.reshape(-1), off.reshape(-1)].set(
-                v.astype(cfg.dtype).reshape(b * l, kv_heads, head_dim)
-            )
-            out = paged_attention(q, ck.value, cv.value, block_tables, p)
+            if ck.value.dtype == jnp.int8:
+                # int8 quantized pool (serving.kv_pool kv_dtype="int8"):
+                # quantize-on-scatter — each written KV row stores int8
+                # values plus its per-head fp32 scale in the scale
+                # siblings, at the same (block, offset) indices. The
+                # read path below dequantizes (in-VMEM for the pallas
+                # spelling). Intra-chunk attention therefore also reads
+                # quantized KV — the same values every later chunk and
+                # decode tick will see, so the stream has ONE consistent
+                # quantization, not an exact-then-quantized seam.
+                from pytorch_distributed_tpu.serving.kv_pool import (
+                    quantize_kv,
+                )
+
+                cks = self.variable("cache", "key_scale", _need_pool)
+                cvs = self.variable("cache", "value_scale", _need_pool)
+                kq, ks_rows = quantize_kv(k)
+                vq, vs_rows = quantize_kv(v)
+                rows = (blk.reshape(-1), off.reshape(-1))
+                ck.value = ck.value.at[rows].set(
+                    kq.reshape(b * l, kv_heads, head_dim)
+                )
+                cv.value = cv.value.at[rows].set(
+                    vq.reshape(b * l, kv_heads, head_dim)
+                )
+                cks.value = cks.value.at[rows].set(
+                    ks_rows.reshape(b * l, kv_heads)
+                )
+                cvs.value = cvs.value.at[rows].set(
+                    vs_rows.reshape(b * l, kv_heads)
+                )
+                out = paged_attention(
+                    q, ck.value, cv.value, block_tables, p,
+                    gather_impl=cfg.gather_impl,
+                    k_scale=cks.value, v_scale=cvs.value,
+                )
+            else:
+                ck.value = ck.value.at[blk.reshape(-1), off.reshape(-1)].set(
+                    k.astype(cfg.dtype).reshape(b * l, kv_heads, head_dim)
+                )
+                cv.value = cv.value.at[blk.reshape(-1), off.reshape(-1)].set(
+                    v.astype(cfg.dtype).reshape(b * l, kv_heads, head_dim)
+                )
+                out = paged_attention(
+                    q, ck.value, cv.value, block_tables, p,
+                    gather_impl=cfg.gather_impl,
+                )
             out = nn.DenseGeneral(
                 e, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
                 name="proj",

@@ -6,12 +6,18 @@ from pytorch_distributed_tpu.ops.attention import (
 
 
 def __getattr__(name):
-    # Lazy: flash_attention pulls in pallas/pltpu; environments without
-    # them keep every other op usable and fail only when flash is chosen.
+    # Lazy: the pallas kernels pull in pallas/pltpu; environments without
+    # them keep every other op usable and fail only when one is chosen.
     if name == "flash_attention":
         from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
         return flash_attention
+    if name == "paged_flash_attention":
+        from pytorch_distributed_tpu.ops.paged_flash import (
+            paged_flash_attention,
+        )
+
+        return paged_flash_attention
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.metrics import topk_correct, ClassificationMetrics

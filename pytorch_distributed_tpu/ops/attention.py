@@ -19,7 +19,10 @@ TPU-first:
   (``parallel/sequence.py``);
 - ``paged_attention`` is the serving engine's read path: decode/chunk
   queries against a block-pooled KV cache through a block table
-  (``serving/kv_pool.py``), dense ``jnp.take``-over-blocks gather.
+  (``serving/kv_pool.py``) — a dense ``jnp.take``-over-blocks gather, or
+  the fused Pallas kernel (``ops/paged_flash.py``) that reads the table
+  from its BlockSpec index maps and never materializes the gather; both
+  spellings accept int8 pools with per-row scales.
 
 Shapes follow the JAX convention: ``[batch, length, heads, head_dim]``.
 """
@@ -155,6 +158,8 @@ def paged_attention(
     *,
     scale: Optional[float] = None,
     gather_impl: str = "dense",
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode/chunk-prefill attention against a block-pooled KV cache.
 
@@ -180,34 +185,49 @@ def paged_attention(
         (their logical positions exceed every query position).
       q_positions: ``[B, C]`` int32 absolute positions of the queries;
         key position j is visible to query i iff ``j <= q_positions[i]``.
-      gather_impl: ``"dense"`` — one ``jnp.take`` over the block dim (the
-        shipped spelling: PERF_NOTES §6's lesson is to change the math XLA
-        sees, not excise ops into custom calls). ``"pallas"`` is reserved
-        for a fused gather-attend kernel and currently raises — the flag
-        exists so call sites are already plumbed when the kernel lands.
-        When it does land, it must join the program-registry bucket
+      gather_impl: ``"dense"`` — one ``jnp.take`` over the block dim,
+        materializing the gathered KV in HBM (the reference spelling;
+        PERF_NOTES §6's lesson is to change the math XLA sees, not excise
+        ops into custom calls). ``"pallas"`` — the fused gather-attend
+        kernel (``ops.paged_flash``): BlockSpec index maps read the
+        block table directly (scalar prefetch), so pool blocks DMA
+        HBM→VMEM in chain order and the gathered copy never exists;
+        runs the Pallas interpreter on non-TPU backends, so both
+        spellings execute everywhere. Either spelling compiles inside
+        the same engine programs, so the program-registry bucket
         enumeration (``compilecache.serving_registry`` over
-        ``PagedEngine.chunk_buckets``; ANALYSIS.md "Cold start & compile
-        cache"): a kernel variant that compiles per bucket outside the
-        registry trips the coverage guard, and the warmup runtime must be
-        able to prewarm it like the dense spelling.
+        ``PagedEngine.chunk_buckets``) covers both and the warmup
+        runtime prewarms whichever the engine was built with.
+      k_scale, v_scale: per-(block, slot, head) fp32 dequantization
+        scales ``[n_blocks, block_len, H_kv]`` — required iff the pools
+        are int8 (``serving.kv_pool`` ``kv_dtype="int8"`` layout). Both
+        spellings dequantize before the softmax statistics; the pallas
+        kernel does it block-by-block in VMEM.
 
     Returns ``[B, C, H, D]`` in q's dtype. Softmax statistics in fp32.
     """
-    if gather_impl == "pallas":
-        raise NotImplementedError(
-            "gather_impl='pallas' (fused block-gather attention kernel) is "
-            "reserved but not implemented; use the default 'dense' "
-            "spelling. When the kernel lands it must register its bucket "
-            "programs with compilecache.serving_registry (ANALYSIS.md "
-            "'Cold start & compile cache') so warmup can prewarm them and "
-            "the coverage guard keeps predicting every compiled variant"
-        )
-    if gather_impl != "dense":
+    if gather_impl not in ("dense", "pallas"):
         raise ValueError(
-            f"gather_impl {gather_impl!r} must be 'dense' (or the reserved "
-            "'pallas'); see compilecache/registry.py for the bucket "
-            "enumeration any new impl must stay in sync with"
+            f"gather_impl {gather_impl!r} must be 'dense' (jnp.take "
+            "gather) or 'pallas' (fused ops.paged_flash kernel); see "
+            "compilecache/registry.py for the bucket enumeration both "
+            "stay in sync with"
+        )
+    quantized = jnp.issubdtype(k_pool.dtype, jnp.integer)
+    if bool(quantized) != (k_scale is not None):
+        raise ValueError(
+            "int8 pools need k_scale/v_scale and float pools must not "
+            f"pass them (pool dtype {k_pool.dtype}, k_scale "
+            f"{'set' if k_scale is not None else 'None'})"
+        )
+    if gather_impl == "pallas":
+        from pytorch_distributed_tpu.ops.paged_flash import (
+            paged_flash_attention,
+        )
+
+        return paged_flash_attention(
+            q, k_pool, v_pool, block_tables, q_positions, scale=scale,
+            k_scale=k_scale, v_scale=v_scale,
         )
     b, c, h, d = q.shape
     n_blocks, block_len, h_kv, _ = k_pool.shape
@@ -225,6 +245,18 @@ def paged_attention(
     vg = jnp.take(v_pool, block_tables, axis=0).reshape(
         b, w * block_len, h_kv, d
     )
+    if k_scale is not None:
+        # int8 pool: dequantize AFTER the gather (per-row-per-head
+        # scales ride the same take), keeping the einsums below on fp32
+        # values identical to what the pallas kernel dequantizes in VMEM
+        ks = jnp.take(k_scale, block_tables, axis=0).reshape(
+            b, w * block_len, h_kv
+        )
+        vs = jnp.take(v_scale, block_tables, axis=0).reshape(
+            b, w * block_len, h_kv
+        )
+        kg = kg.astype(jnp.float32) * ks[..., None]  # jaxlint: disable=precision-cast -- int8 dequantization to the fp32 softmax-statistics dtype
+        vg = vg.astype(jnp.float32) * vs[..., None]  # jaxlint: disable=precision-cast -- int8 dequantization to the fp32 softmax-statistics dtype
     # Grouped logits directly against the narrow heads (query head
     # h = h_kv_idx*group + g), fp32 statistics like every other path.
     qg = (q.astype(jnp.float32) * scale).reshape(b, c, h_kv, group, d)  # jaxlint: disable=precision-cast -- fp32 softmax statistics by kernel contract

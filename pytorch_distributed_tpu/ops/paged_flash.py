@@ -1,0 +1,260 @@
+"""Fused paged-attention Pallas kernel: flash-decode over a block-pooled
+KV cache, reading the block tables directly from SMEM.
+
+This is the ``gather_impl="pallas"`` spelling of
+``ops.attention.paged_attention`` (the serving read path). The dense
+spelling gathers every request's block chain back into a logical
+``[B, W·block_len, H_kv, D]`` sequence with ``jnp.take`` — materializing
+the full gathered KV in HBM on every decode tick, the exact cost
+PagedAttention (Kwon et al., SOSP 2023 — PAPERS.md) exists to avoid.
+Here the gather never materializes: the block table rides in as a
+scalar-prefetch operand (SMEM), and each KV block's BlockSpec *index
+map* resolves ``tables[b, j]`` — so the pipeline DMAs pool blocks
+HBM→VMEM in chain order directly, touching only the chain's blocks.
+
+Structure (per the in-tree FlashAttention kernel,
+``ops/flash_attention.py``, and the TPU Pallas playbook
+``/opt/skills/guides/pallas_guide.md``):
+
+- grid ``(B, H_kv, W)`` with the block-chain sweep innermost and
+  sequential ("arbitrary" semantics — it carries the online-softmax
+  recurrence); the running (m, l, acc) state lives in VMEM scratch,
+  persisting across the chain for each (batch row, narrow head);
+- GQA is folded into the row dimension: queries regroup to
+  ``[B, H_kv, G·C, D]`` so each narrow head's whole query group shares
+  one staged KV block — the widened K/V never exists, mirroring the
+  dense spelling's grouped einsum. ``C == 1`` (decode tick) and
+  ``C == chunk`` (chunked prefill) are the same kernel at different row
+  counts;
+- causal/frontier masking ``k_pos <= q_position`` per row; table
+  entries past a request's allocation point at the trash block, whose
+  logical positions exceed every live query position, so they mask out
+  exactly like the dense spelling. Blocks entirely past the batch row's
+  query frontier are skipped with ``pl.when`` (no FLOPs, no dequant);
+- softmax statistics in fp32 regardless of pool/compute dtype;
+- int8 pools dequantize INSIDE the kernel: per-(block, slot, head)
+  scales (``serving.kv_pool.quantize_kv``) ride the same index maps as
+  their pool, so the f32 K/V rows exist only in VMEM, block by block —
+  HBM holds int8 + scales (the ~2x pool-capacity win);
+- ``interpret=None`` auto-detects non-TPU backends and runs the Pallas
+  interpreter, so CPU tier-1 executes the same call sites unmodified
+  (the ``flash_attention`` convention).
+
+Shapes follow the framework convention: q ``[B, C, H, D]``, pools
+``[n_blocks, block_len, H_kv, D]``, tables ``[B, W]``, positions
+``[B, C]``.
+"""
+# jaxlint: disable-file=precision-cast -- the kernel's softmax state (m, l, acc) is fp32 by the attention-path contract and int8 pool blocks dequantize to fp32 in VMEM; every cast here feeds that fp32 recurrence
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_distributed_tpu.ops.attention import NEG_INF
+
+# jax 0.4.3x names the param class TPUCompilerParams; newer releases
+# CompilerParams (which ops/flash_attention.py uses). Resolve once so the
+# non-interpret branch works on either.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
+
+def _paged_kernel(
+    tables_ref,  # scalar-prefetch [B, W] int32 (SMEM)
+    q_ref, qpos_ref, k_ref, v_ref,  # + (ks_ref, vs_ref) when quantized
+    *refs,
+    scale: float, block_len: int, quantized: bool,
+):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
+    j = pl.program_id(2)
+    n_w = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qpos = qpos_ref[0]  # [R] per-row absolute query positions (pad = -1)
+    k_start = j * block_len
+
+    def _block():
+        # Fold the softmax scale into Q (one [R, D] multiply, the flash
+        # kernel's trick), fp32 logits on the MXU.
+        q = q_ref[0, 0]  # [R, D]
+        k = k_ref[0, :, 0, :]  # [block_len, D]
+        v = v_ref[0, :, 0, :]
+        if quantized:
+            # dequantize THIS block only, in VMEM: per-(slot, head)
+            # scales gathered by the same table-driven index map
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+        s = jax.lax.dot_general(
+            q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, block_len]
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # Frontier mask: key position j visible iff j <= the row's query
+        # position. Trash-table entries (unallocated tail) carry logical
+        # positions past every live frontier → fully masked, exactly the
+        # dense spelling's argument. Padding rows (qpos == -1) mask
+        # everything → l stays 0 → zeros out, sliced away by the caller.
+        mask = k_pos <= qpos[:, None]
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = p * mask  # fully-masked rows stay all-zero (l == 0 → out 0)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    # A chain block entirely past this batch row's query frontier
+    # contributes nothing — skip its FLOPs (and its dequant) entirely.
+    pl.when(k_start <= jnp.max(qpos))(_block)
+
+    @pl.when(j == n_w - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-37)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_flash_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    q_positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused block-gather attention: decode/chunk queries against a
+    block-pooled KV cache, no materialized gather.
+
+    Args:
+      q: ``[B, C, H, D]`` — C == 1 for a decode tick, C == chunk for
+        chunked prefill.
+      k_pool, v_pool: ``[n_blocks, block_len, H_kv, D]`` pooled cache
+        (``H_kv <= H``, GQA); float dtypes, or int8 with ``k_scale``/
+        ``v_scale`` set.
+      block_tables: ``[B, W]`` int32 — request b's logical positions
+        ``[w·block_len, (w+1)·block_len)`` live in pool block
+        ``block_tables[b, w]``.
+      q_positions: ``[B, C]`` int32 absolute positions; key position j
+        is visible to query i iff ``j <= q_positions[i]``.
+      k_scale, v_scale: ``[n_blocks, block_len, H_kv]`` fp32
+        dequantization scales for int8 pools
+        (``serving.kv_pool.quantize_kv`` layout); None for float pools.
+      interpret: force the Pallas interpreter; None auto-detects
+        (interpreter on any non-TPU backend, like ``flash_attention``).
+
+    Returns ``[B, C, H, D]`` in q's dtype; softmax statistics fp32.
+    """
+    b, c, h, d = q.shape
+    n_blocks, block_len, h_kv, _ = k_pool.shape
+    if h % h_kv:
+        raise ValueError(
+            f"query heads {h} not a multiple of pool KV heads {h_kv}"
+        )
+    quantized = jnp.issubdtype(k_pool.dtype, jnp.integer)
+    if quantized != (k_scale is not None):
+        raise ValueError(
+            "int8 pools need k_scale/v_scale and float pools must not "
+            f"pass them (pool {k_pool.dtype}, k_scale "
+            f"{'set' if k_scale is not None else 'None'})"
+        )
+    if interpret is None:
+        # Mosaic compiles only on TPU; every other backend runs the
+        # interpreter so CPU tier-1 executes this exact call site.
+        interpret = jax.default_backend() != "tpu"
+    group = h // h_kv
+    w = block_tables.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+
+    # GQA fold: query head h = kv·group + g reads narrow head kv, so the
+    # per-narrow-head row block is its whole query group × chunk. Rows
+    # pad to a sublane multiple; padding rows carry position -1 (every
+    # key masked → zero rows, sliced away below).
+    r = group * c
+    r_pad = -(-r // 8) * 8
+    q4 = jnp.moveaxis(q.reshape(b, c, h_kv, group, d), 1, 3)  # [B,Hkv,G,C,D]
+    q4 = q4.reshape(b, h_kv, r, d)
+    qpos = jnp.broadcast_to(
+        q_positions.astype(jnp.int32)[:, None, :], (b, group, c)
+    ).reshape(b, r)
+    if r_pad != r:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, r_pad - r), (0, 0)))
+        qpos = jnp.pad(qpos, ((0, 0), (0, r_pad - r)), constant_values=-1)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, r_pad, d), lambda b, h, j, t: (b, h, 0, 0)),
+        pl.BlockSpec((1, r_pad), lambda b, h, j, t: (b, 0)),
+        # the fused gather: the block table entry IS the index map — the
+        # pipeline DMAs pool block tables[b, j] (this narrow head's
+        # slice) straight into VMEM, no gathered copy in HBM
+        pl.BlockSpec((1, block_len, 1, d),
+                     lambda b, h, j, t: (t[b, j], 0, h, 0)),
+        pl.BlockSpec((1, block_len, 1, d),
+                     lambda b, h, j, t: (t[b, j], 0, h, 0)),
+    ]
+    operands = [q4, qpos, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_len, 1),
+                         lambda b, h, j, t: (t[b, j], 0, h)),
+            pl.BlockSpec((1, block_len, 1),
+                         lambda b, h, j, t: (t[b, j], 0, h)),
+        ]
+        operands += [k_scale, v_scale]
+    out_dtype = q.dtype
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h_kv, w),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, r_pad, d),
+                               lambda b, h, j, t: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((r_pad, 128), jnp.float32),  # running row max m
+            pltpu.VMEM((r_pad, 128), jnp.float32),  # running row sum l
+            pltpu.VMEM((r_pad, d), jnp.float32),  # un-normalized output
+        ],
+    )
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = _COMPILER_PARAMS(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    out4 = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=scale, block_len=block_len,
+            quantized=bool(quantized),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, r_pad, d), out_dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        **kwargs,
+    )(block_tables.astype(jnp.int32), *operands)
+    out4 = out4[:, :, :r]  # drop row padding
+    return jnp.moveaxis(
+        out4.reshape(b, h_kv, group, c, d), 3, 1
+    ).reshape(b, c, h, d)

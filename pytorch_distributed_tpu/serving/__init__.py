@@ -22,24 +22,36 @@ rows with a fixed pool of KV *blocks* plus per-slot block tables:
 (``cache_layout="paged"``); the dense layout survives as
 ``cache_layout="dense"`` for parity tests. ANALYSIS.md "Serving engine"
 documents the block layout and the admission path.
+
+Round 12: the read path gains its fused Pallas kernel
+(``gather_impl="pallas"`` → ``ops.paged_flash``, no materialized
+gather) and the pool an int8 quantized variant (``kv_dtype="int8"``,
+per-row scales, ~2x blocks at fixed bytes) — ANALYSIS.md "Paged
+attention kernel & quantized KV".
 """
 
 from pytorch_distributed_tpu.serving.kv_pool import (
+    KV_DTYPES,
     TRASH_BLOCK,
     BlockAllocator,
     blocks_needed,
     init_paged_cache,
     paged_cache_specs,
+    pool_block_bytes,
+    quantize_kv,
 )
 from pytorch_distributed_tpu.serving.engine import KVExport, PagedEngine
 from pytorch_distributed_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = [
+    "KV_DTYPES",
     "TRASH_BLOCK",
     "BlockAllocator",
     "blocks_needed",
     "init_paged_cache",
     "paged_cache_specs",
+    "pool_block_bytes",
+    "quantize_kv",
     "KVExport",
     "PagedEngine",
     "Request",

@@ -16,9 +16,12 @@ every update is in place:
   count.
 - **decode** (``decode``): one token for every slot, exactly the dense
   ``_step_body`` shape but attending through the block table
-  (``ops.attention.paged_attention``). Inactive lanes' writes are routed
-  to the trash block by host-side table masking, so recycled blocks can
-  never be corrupted by a dead lane.
+  (``ops.attention.paged_attention`` — the dense gather or, with
+  ``gather_impl="pallas"``, the fused ``ops.paged_flash`` kernel; with
+  ``kv_dtype="int8"`` the pool is quantized with per-row scales).
+  Inactive lanes' writes are routed to the trash block by host-side
+  table masking, so recycled blocks can never be corrupted by a dead
+  lane.
 
 Tensor parallelism reuses the dense serving path's machinery: params
 placed by ``models.generate._tp_rules``, the pool head-sharded by
@@ -108,16 +111,31 @@ class PagedEngine:
                  n_blocks: Optional[int] = None, block_len: int = 16,
                  prefill_chunk: int = 128, temperature: float = 0.0,
                  top_k: Optional[int] = None, mesh=None, device=None,
-                 handoff: bool = False):
+                 handoff: bool = False, gather_impl: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         from pytorch_distributed_tpu.models.generate import (
             _validate_sampling,
             _validate_serving_config,
         )
+        from pytorch_distributed_tpu.serving.kv_pool import KV_DTYPES
 
         _validate_serving_config(config, mesh)
         _validate_sampling(config, temperature, top_k)
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        # KV gather spelling: an explicit gather_impl= overrides the
+        # config field (replaced INTO the config so the model, the
+        # registry fingerprint, and this engine agree on one value —
+        # TransformerConfig validates it). kv_dtype="int8" swaps the
+        # pool for the quantized layout (kv_pool.init_paged_cache); the
+        # model's scatter path keys off the pool dtype, nothing else.
+        if gather_impl is not None and gather_impl != config.gather_impl:
+            config = dataclasses.replace(config, gather_impl=gather_impl)
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype {kv_dtype!r} must be one of {KV_DTYPES}"
+            )
+        self.kv_dtype = kv_dtype
         if mesh is not None and device is not None:
             raise ValueError(
                 "pass mesh= (TP sub-mesh) or device= (single-device "
@@ -144,7 +162,8 @@ class PagedEngine:
             dataclasses.replace(config, model_axis=None, tp_size=1)
             if tp else config
         )
-        self.cache = init_paged_cache(init_cfg, params, n_blocks, block_len)
+        self.cache = init_paged_cache(init_cfg, params, n_blocks, block_len,
+                                      kv_dtype=kv_dtype)
         self.logits = jnp.zeros((n_slots, config.vocab_size), jnp.float32)
 
         self._chunk_fns: Dict[Tuple[int, int], callable] = {}
@@ -200,6 +219,12 @@ class PagedEngine:
             self.params = jax.device_put(self.params, device)
             self.cache = jax.device_put(self.cache, device)
             self.logits = jax.device_put(self.logits, device)
+
+    @property
+    def gather_impl(self) -> str:
+        """The KV gather spelling the engine's programs compile with
+        (lives on the config so model, fingerprint, and engine agree)."""
+        return self.config.gather_impl
 
     # ---- program builders (cached per static shape) ----
 

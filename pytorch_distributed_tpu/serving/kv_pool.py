@@ -32,6 +32,30 @@ import jax.numpy as jnp
 
 TRASH_BLOCK = 0
 
+#: pool dtypes ``init_paged_cache`` accepts: None keeps the model compute
+#: dtype (the raw layout); "int8" stores quantized K/V plus per-
+#: (block, slot, head) fp32 scales — ~2x the blocks at fixed pool bytes
+#: (exactly 2D/(D+4) with fp32 scales; ANALYSIS.md "Paged attention
+#: kernel & quantized KV").
+KV_DTYPES = (None, "int8")
+
+
+def quantize_kv(x: jax.Array):
+    """Symmetric per-(token, head) int8 quantization of a K or V chunk.
+
+    ``x`` is ``[..., H_kv, D]``; returns ``(q int8 same shape, scales
+    fp32 [..., H_kv])`` with ``q = round(x / scale)`` and
+    ``scale = amax(|x|, D) / 127`` — one scale per written KV row, the
+    granularity the paged scatter writes at (a per-BLOCK scalar cannot
+    be maintained under incremental chunk/decode writes without
+    requantizing the block's resident rows). Dequantization is
+    ``q * scale`` (``ops.paged_flash`` does it in VMEM; the dense gather
+    right after the take)."""
+    xf = x.astype(jnp.float32)  # jaxlint: disable=precision-cast -- fp32 quantization statistics regardless of compute dtype
+    scales = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scales[..., None]), -127, 127)
+    return q.astype(jnp.int8), scales
+
 
 def blocks_needed(prompt_len: int, max_new_tokens: int, block_len: int,
                   chunk: int) -> int:
@@ -105,7 +129,8 @@ class BlockAllocator:
             self._free.extend(reversed(chain))
 
 
-def init_paged_cache(config, params, n_blocks: int, block_len: int):
+def init_paged_cache(config, params, n_blocks: int, block_len: int,
+                     kv_dtype: Optional[str] = None):
     """Zero block-pooled KV cache for ``TransformerLM(config)``.
 
     Shapes come from ``eval_shape`` on the dense decode cache at batch 1
@@ -114,25 +139,95 @@ def init_paged_cache(config, params, n_blocks: int, block_len: int):
     ``[n_blocks, block_len, H_kv, D]`` pool — the per-layer head count
     and dtype (GQA narrows H_kv; TP shards it by placement) carry over
     unchanged, so the pool works for every config the dense cache does.
+
+    ``kv_dtype="int8"`` stores the pools quantized: each ``key``/
+    ``value`` leaf becomes int8 and gains a ``key_scale``/``value_scale``
+    sibling ``[n_blocks, block_len, H_kv]`` fp32 (the ``quantize_kv``
+    layout — one scale per written row per head, so quantize-on-scatter
+    and TP head-sharding both work unchanged). The attention read path
+    dequantizes (in-VMEM for ``gather_impl="pallas"``, post-take for
+    "dense"); ``models.transformer.Attention`` switches to quantize-on-
+    scatter off the pool dtype alone, so the cache pytree IS the whole
+    contract — no config flag to drift from it.
     """
     from pytorch_distributed_tpu.models.generate import init_cache
 
     if block_len < 1:
         raise ValueError(f"block_len must be >= 1, got {block_len}")
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype {kv_dtype!r} must be one of {KV_DTYPES} (None "
+            "keeps the model compute dtype)"
+        )
     shapes = jax.eval_shape(
         lambda p: init_cache(config, p, 1), params
     )
-    return jax.tree.map(
-        lambda s: jnp.zeros((n_blocks, block_len) + s.shape[2:], s.dtype),
-        shapes,
+    if kv_dtype is None:
+        return jax.tree.map(
+            lambda s: jnp.zeros((n_blocks, block_len) + s.shape[2:],
+                                s.dtype),
+            shapes,
+        )
+
+    from collections.abc import Mapping
+
+    def _quantized(node):
+        # each layer's attention cache is a {"key": [1, L, H_kv, D],
+        # "value": ...} pair; replace it with int8 pools + scale siblings
+        if isinstance(node, Mapping) and set(node) == {"key", "value"}:
+            out = {}
+            for name in ("key", "value"):
+                s = node[name]
+                out[name] = jnp.zeros(
+                    (n_blocks, block_len) + s.shape[2:], jnp.int8
+                )
+                out[name + "_scale"] = jnp.zeros(
+                    (n_blocks, block_len, s.shape[2]), jnp.float32
+                )
+            return out
+        if isinstance(node, Mapping):
+            return {k: _quantized(node[k]) for k in node}
+        raise ValueError(
+            "unexpected cache tree layout for kv_dtype='int8': expected "
+            "nested dicts ending in {'key', 'value'} leaf pairs, got "
+            f"{type(node).__name__}"
+        )
+
+    return _quantized(shapes)
+
+
+def pool_block_bytes(config, params, block_len: int,
+                     kv_dtype: Optional[str] = None) -> int:
+    """HBM bytes ONE pool block costs across every layer (K + V + any
+    scale siblings) — the unit the capacity A/B divides a fixed byte
+    budget by (``scripts/bench_serving.py --gather-ab``). Pure
+    ``eval_shape`` arithmetic; nothing is allocated."""
+    shapes = jax.eval_shape(
+        lambda p: init_paged_cache(config, p, 2, block_len,
+                                   kv_dtype=kv_dtype),
+        params,
     )
+    total = sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(shapes)
+    )
+    return total // 2
 
 
 def paged_cache_specs(config, cache):
     """TP placement for the pool: the HEAD dim (axis 2 — same leaf rank
     as the dense cache) shards over the model axis, exactly the slice
     each shard's Attention computes. Reuses the dense serving rule
-    (``models.generate._cache_specs``) so the two layouts cannot drift."""
+    (``models.generate._cache_specs``) so the two layouts cannot drift.
+    An int8 pool's rank-3 scale leaves ``[n_blocks, block_len, H_kv]``
+    shard the same head dim (now the LAST axis): their spec is the
+    rank-4 rule with its trailing D entry dropped — derived, so it
+    cannot drift either."""
+    from jax.sharding import PartitionSpec as P
+
     from pytorch_distributed_tpu.models.generate import _cache_specs
 
-    return _cache_specs(config, cache)
+    specs = _cache_specs(config, cache)
+    return jax.tree.map(
+        lambda leaf, spec: spec if leaf.ndim == 4 else P(*tuple(spec)[:3]),
+        cache, specs,
+    )

@@ -126,7 +126,9 @@ class Scheduler:
                  tracer=None, metrics_log=None, replica_id: int = 0,
                  prefill_only: bool = False, device=None,
                  handoff: bool = False, flightrec=None,
-                 anomaly_threshold: float = 8.0):
+                 anomaly_threshold: float = 8.0,
+                 gather_impl: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
 
         if eos_id is not None and not 0 <= eos_id < config.vocab_size:
@@ -142,8 +144,11 @@ class Scheduler:
             prefill_chunk=prefill_chunk, temperature=temperature,
             top_k=top_k, mesh=mesh, device=device,
             handoff=(handoff or prefill_only),
+            gather_impl=gather_impl, kv_dtype=kv_dtype,
         )
-        self.config = config
+        # the engine may have replaced gather_impl= into the config —
+        # read back its copy so scheduler and programs agree
+        self.config = self.engine.config
         self.n_slots = n_slots
         self.admit_per_step = admit_per_step
         self.eos_id = eos_id

@@ -96,6 +96,14 @@ def _parse() -> argparse.Namespace:
                    help="prefill chunk length (paged) / bucket (dense)")
     p.add_argument("--admit-per-step", type=int, default=4,
                    help="max admissions per scheduler tick")
+    p.add_argument("--gather-impl", choices=("dense", "pallas"),
+                   default=None,
+                   help="paged KV gather spelling: 'dense' jnp.take or "
+                        "'pallas' fused kernel (ops/paged_flash.py; "
+                        "interpret mode off-TPU)")
+    p.add_argument("--kv-dtype", choices=("int8",), default=None,
+                   help="quantize the KV block pool (int8 + per-row "
+                        "scales, ~2x blocks at fixed pool bytes)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dense", action="store_true",
                    help="run the r4 dense layout instead (A/B reference)")
@@ -260,6 +268,7 @@ def main() -> None:
             metrics_log=mlog, tracer=tracer, n_slots=args.slots,
             block_len=args.block_len, prefill_chunk=args.prefill_chunk,
             admit_per_step=args.admit_per_step,
+            gather_impl=args.gather_impl, kv_dtype=args.kv_dtype,
         )
         if args.warmup:
             router.warmup()
@@ -306,6 +315,9 @@ def main() -> None:
             raise SystemExit("--warmup needs the paged layout (the dense "
                              "ContinuousBatcher has no program registry); "
                              "drop --dense")
+        if args.gather_impl or args.kv_dtype:
+            raise SystemExit("--gather-impl/--kv-dtype are block-pool "
+                             "knobs; drop --dense")
         if args.tp > 1:
             raise SystemExit("--tp > 1 needs the paged layout; drop "
                              "--dense")
@@ -328,6 +340,7 @@ def main() -> None:
             prefill_chunk=args.prefill_chunk,
             admit_per_step=args.admit_per_step, seed=args.seed,
             mesh=mesh, tracer=tracer, metrics_log=mlog,
+            gather_impl=args.gather_impl, kv_dtype=args.kv_dtype,
         )
         if args.warmup:
             # everything foreground + executed inert: the serve loop below

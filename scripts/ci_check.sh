@@ -8,7 +8,8 @@
 # Usage: scripts/ci_check.sh [--lint-only|--lint-incremental|
 #                             --resilience-smoke|--serving-smoke|
 #                             --telemetry-smoke|--warmup-smoke|--reshard-smoke|
-#                             --fleet-smoke|--obs-smoke|--bench-regression]
+#                             --fleet-smoke|--obs-smoke|--kernel-smoke|
+#                             --bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -26,6 +27,11 @@
 # cycle (tests/test_paged_serving.py::test_serving_smoke) — the cheap
 # end-to-end proof the paged serving path still admits, decodes, and
 # returns its blocks, without the parity/TP tier.
+#
+# --kernel-smoke: lint, then one pallas-gather + int8-pool serve cycle
+# (token-identical to generate; Pallas interpreter on CPU) + the int8
+# logit-error bound + a tiny --gather-ab run (A/B plumbing + JSON keys;
+# the throughput claim itself is TPU-only).
 #
 # --telemetry-smoke: lint, then one short LM training run and one
 # paged-serving cycle with --metrics-out, then telemetry_report.py must
@@ -109,6 +115,22 @@ if [[ "${1:-}" == "--serving-smoke" ]]; then
     JAX_PLATFORMS=cpu python -m pytest \
         tests/test_paged_serving.py::test_serving_smoke -q \
         -p no:cacheprovider -p no:xdist -p no:randomly
+    exit 0
+fi
+
+if [[ "${1:-}" == "--kernel-smoke" ]]; then
+    echo "== kernel smoke (pallas gather + int8 pool serve cycle; A/B sanity) =="
+    # one full pallas-path + int8-pool serve cycle, token-identical to
+    # the generate reference (interpret mode on CPU), then the gather
+    # A/B on the tiny model as a plumbing/JSON-schema sanity check (the
+    # pallas>=dense throughput claim is TPU-only; the CPU run exercises
+    # the same code path through the Pallas interpreter)
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_paged_kernel.py::test_kernel_smoke \
+        tests/test_paged_kernel.py::test_int8_pool_logit_error_bound -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --gather-ab --tiny \
+        --ab-slots 4 --ab-ticks 8 --ab-prompt-len 32
     exit 0
 fi
 

@@ -139,10 +139,15 @@ def test_paged_attention_gather_impl_flag():
     pool = jnp.zeros((2, 4, 2, 4))
     t = jnp.zeros((1, 1), jnp.int32)
     p = jnp.zeros((1, 1), jnp.int32)
-    with pytest.raises(NotImplementedError, match="pallas"):
-        paged_attention(z, pool, pool, t, p, gather_impl="pallas")
     with pytest.raises(ValueError, match="gather_impl"):
         paged_attention(z, pool, pool, t, p, gather_impl="nope")
+    # round 12: "pallas" is no longer reserved — it dispatches to the
+    # fused kernel (ops/paged_flash.py; parity in tests/test_paged_
+    # kernel.py) and must agree with the dense spelling even on this
+    # degenerate all-zeros pool
+    out = paged_attention(z, pool, pool, t, p, gather_impl="pallas")
+    ref = paged_attention(z, pool, pool, t, p, gather_impl="dense")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
 
 
 # ---------------------------------------------------------------------------
