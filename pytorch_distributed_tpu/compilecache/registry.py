@@ -284,4 +284,20 @@ def serving_registry(engine, extra: Iterable = ()) -> ProgramRegistry:
                   engine.warm_import(n, execute=execute)),
             aot=lambda n=n_pad: engine.warm_import(n, execute=False),
         ))
+    # host-offload swap programs (round 13 pressure tier; empty unless
+    # the engine was built with swap=True — read from the engine so the
+    # registry and the swap path's lazy bucketing cannot drift)
+    for n_pad in engine.swap_buckets():
+        reg.add(ProgramSpec(
+            name=engine.swap_out_program_name(n_pad),
+            warm=(lambda execute, n=n_pad:
+                  engine.warm_swap_out(n, execute=execute)),
+            aot=lambda n=n_pad: engine.warm_swap_out(n, execute=False),
+        ))
+        reg.add(ProgramSpec(
+            name=engine.swap_in_program_name(n_pad),
+            warm=(lambda execute, n=n_pad:
+                  engine.warm_swap_in(n, execute=execute)),
+            aot=lambda n=n_pad: engine.warm_swap_in(n, execute=False),
+        ))
     return reg

@@ -25,6 +25,7 @@ caveats.
 
 from pytorch_distributed_tpu.fleet.admission import (
     ADMIT,
+    PREEMPT,
     SHED,
     SPILL,
     Decision,
@@ -45,6 +46,7 @@ from pytorch_distributed_tpu.fleet.traffic import (
 
 __all__ = [
     "ADMIT",
+    "PREEMPT",
     "SHED",
     "SPILL",
     "Decision",

@@ -16,8 +16,17 @@ decision:
   prefix locality for latency;
 - **queue** on the least-loaded replica when every replica is hot but
   none is past the shed bound — backpressure, not failure;
-- **shed** (explicit reject, reason in the per-request JSONL) only when
-  EVERY replica is past ``shed_queue_depth`` — admitting one more
+- **preempt** (round 13, the KV pressure tier) when every replica is
+  past the shed bound but some replica still holds preemptible resident
+  chains (``Scheduler.metrics()["preemptible"]`` — offload-enabled
+  replicas report their eligible LRU victims): the router parks one
+  idle chain there (swap-to-host or recompute, the measured
+  cost-card choice) and queues the new request in its place — a cheap
+  preemption instead of a user-visible reject;
+- **shed** (explicit reject, reason in the per-request JSONL) only as
+  the LAST resort: every replica past ``shed_queue_depth`` AND no
+  preemptible capacity anywhere (and, for offload fleets, the pressure
+  queue bound ``pressure_queue_depth`` exhausted) — admitting one more
   request could not possibly meet the SLO, and an honest fast reject
   beats a token stream that arrives after the client gave up.
 
@@ -41,6 +50,7 @@ from typing import Dict, NamedTuple, Optional, Sequence
 
 #: Decision.action values
 ADMIT, SPILL, SHED = "admit", "spill", "shed"
+PREEMPT = "preempt"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +65,12 @@ class SLOConfig:
     spill_queue_depth: int = 4
     #: reject (with reason) once EVERY replica queues this deep
     shed_queue_depth: int = 64
+    #: pressure backstop (round 13): when every replica is past the shed
+    #: bound and no chain is preemptible RIGHT NOW, an offload-capable
+    #: replica may still queue the request up to this depth (None = no
+    #: bound — the zero-shed mode: pressure degrades to backpressure,
+    #: never to rejects, as long as the pressure tier is on)
+    pressure_queue_depth: Optional[int] = None
 
     def __post_init__(self):
         if self.spill_queue_depth < 1:
@@ -64,12 +80,21 @@ class SLOConfig:
                 "shed_queue_depth must be >= spill_queue_depth "
                 f"({self.shed_queue_depth} < {self.spill_queue_depth})"
             )
+        if (self.pressure_queue_depth is not None
+                and self.pressure_queue_depth < self.shed_queue_depth):
+            raise ValueError(
+                "pressure_queue_depth must be >= shed_queue_depth "
+                f"({self.pressure_queue_depth} < {self.shed_queue_depth})"
+            )
 
 
 class Decision(NamedTuple):
-    """One routing decision: ``action`` ∈ {admit, spill, shed},
+    """One routing decision: ``action`` ∈ {admit, spill, preempt, shed},
     ``replica`` the target id (-1 on shed), ``reason`` why the affinity
-    replica was left / the request was shed ('' on plain admits)."""
+    replica was left / the request was shed ('' on plain admits). A
+    ``preempt`` decision means: park one LRU chain on ``replica`` (the
+    router calls ``Scheduler.preempt_lru``) and queue the request
+    there."""
 
     action: str
     replica: int
@@ -136,6 +161,36 @@ class SLOGate:
             action = SPILL if preferred is not None else ADMIT
             return Decision(action, cool[0], hot.get(preferred) or "")
         if all(self.overloaded(m) for m in metrics.values()):
+            # the preempt rung (round 13): before shedding, park an
+            # idle resident chain on the least-loaded replica that has
+            # one — pressure degrades to a cheap preemption, shed stays
+            # the last resort
+            preemptable = [
+                i for i in by_load
+                if metrics[i].get("preemptible", 0) > 0
+                and not metrics[i].get("draining")
+            ]
+            if preemptable:
+                i = preemptable[0]
+                return Decision(PREEMPT, i, hot[i] or "pressure")
+            # nothing preemptible RIGHT NOW (protection windows, chains
+            # mid-swap): an offload fleet still queues up to the
+            # pressure bound — its parked work WILL free capacity
+            pressured = [
+                i for i in by_load
+                if metrics[i].get("offload")
+                and not metrics[i].get("draining")
+                and (self.slo.pressure_queue_depth is None
+                     or metrics[i]["queue_depth"]
+                     < self.slo.pressure_queue_depth)
+            ]
+            if pressured:
+                i = pressured[0]
+                action = (
+                    SPILL if preferred is not None and i != preferred
+                    else ADMIT
+                )
+                return Decision(action, i, "pressure-queue")
             victim = preferred if preferred is not None else by_load[0]
             return Decision(SHED, -1, hot[victim] or "queue_depth")
         # every replica hot, none past the shed bound: queue on the

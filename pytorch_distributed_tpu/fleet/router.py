@@ -51,6 +51,7 @@ import numpy as np
 
 from pytorch_distributed_tpu.fleet.admission import (
     ADMIT,
+    PREEMPT,
     SHED,
     SPILL,
     SLOConfig,
@@ -150,6 +151,7 @@ class FleetRouter:
         self.rejected: Dict[int, str] = {}  # rid -> shed reason
         self.results: Dict[int, List[int]] = {}
         self._spilled = 0
+        self._preempt_routes = 0
         self._handoff_count = 0
         self.handoff_lat = LatencySeries("handoff")
         self._start_time: Optional[float] = None
@@ -197,6 +199,19 @@ class FleetRouter:
             self._spilled += 1
             self.flightrec.record(
                 "spill", rid=rid, to=target, reason=decision.reason
+            )
+        elif decision.action == PREEMPT:
+            # the pressure rung: park one LRU chain on the target, then
+            # queue this request in the capacity it frees. A victim can
+            # vanish between the gate's metrics read and now — the
+            # request still queues there (backpressure, not failure).
+            victim = self.replicas[target].preempt_lru(
+                reason=decision.reason or "pressure"
+            )
+            self._preempt_routes += 1
+            self.flightrec.record(
+                "preempt_route", rid=rid, to=target, victim=victim,
+                reason=decision.reason,
             )
         self.replicas[target].submit(
             prompt, max_new_tokens, session=session,
@@ -266,9 +281,9 @@ class FleetRouter:
 
     @property
     def idle(self) -> bool:
-        return all(
-            not s.queue and not s.resident for s in self.replicas
-        )
+        # Scheduler.idle counts parked and mid-swap requests as
+        # in-flight work, so a drain never strands a preempted stream
+        return all(s.idle for s in self.replicas)
 
     def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Step until every replica is empty; returns ``{rid: [tokens]}``
@@ -348,6 +363,17 @@ class FleetRouter:
                 if elapsed else 0.0
             ),
             "handoffs": self._handoff_count,
+            # pressure tier rollup (round 13): fleet-wide preemptions,
+            # restores, parked chains, and swap traffic — shed stays the
+            # headline failure count these exist to zero out
+            "preempt_routes": self._preempt_routes,
+            "preempts": sum(m["preempts"] for m in per),
+            "restores": sum(m["restores"] for m in per),
+            "parked": sum(m["parked"] for m in per),
+            "swap_bytes": sum(m["swap_bytes"] for m in per),
+            "preempt_rate": (
+                sum(m["preempts"] for m in per) / placed if placed else 0.0
+            ),
             "recommended_replicas": self.recommend_replicas(),
             "recommended_replicas_peak": self._recommend_peak,
         }
@@ -360,7 +386,8 @@ class FleetRouter:
                 out[f"{name}_{q}_s"] = v
         for i, m in enumerate(per):
             for k in ("tokens_out", "completed", "queue_depth",
-                      "occupancy_mean", "goodput_frac"):
+                      "occupancy_mean", "goodput_frac", "preempts",
+                      "restores"):
                 out[f"r{i}_{k}"] = m[k]
             for k in ("ttft_p95_s", "queue_wait_p95_s"):
                 if k in m:

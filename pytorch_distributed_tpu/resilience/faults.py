@@ -24,6 +24,15 @@ site                  placed at
 ``ckpt.post_commit``  immediately after the manifest replace — the new
                       checkpoint is live, stale-shard GC has not run
 ``train.step``        the trainer loop, once per step before dispatch
+``kv.swap_out_d2h``   ``serving/engine.py`` ``swap_out_finish``, before
+                      the gathered chain's device→host materialization —
+                      a failure here leaves the chain resident, intact
+``kv.host_write``     same method, after d2h but BEFORE the host-store
+                      commit — the classic half-swapped hazard; the
+                      chain is still resident until the commit lands
+``kv.swap_in_h2d``    ``serving/engine.py`` ``swap_in_chain``, before
+                      any device write of a restoring chain — a failure
+                      frees the fresh blocks, host copy stays retryable
 ====================  =====================================================
 
 Fault kinds:

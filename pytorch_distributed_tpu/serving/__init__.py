@@ -32,21 +32,35 @@ attention kernel & quantized KV".
 
 from pytorch_distributed_tpu.serving.kv_pool import (
     KV_DTYPES,
+    SWAP_STATES,
+    SWAPPING_IN,
+    SWAPPING_OUT,
     TRASH_BLOCK,
     BlockAllocator,
+    HostBlockStore,
+    HostChain,
     blocks_needed,
     init_paged_cache,
     paged_cache_specs,
     pool_block_bytes,
     quantize_kv,
 )
-from pytorch_distributed_tpu.serving.engine import KVExport, PagedEngine
+from pytorch_distributed_tpu.serving.engine import (
+    KVExport,
+    PagedEngine,
+    PendingSwap,
+)
 from pytorch_distributed_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = [
     "KV_DTYPES",
+    "SWAP_STATES",
+    "SWAPPING_IN",
+    "SWAPPING_OUT",
     "TRASH_BLOCK",
     "BlockAllocator",
+    "HostBlockStore",
+    "HostChain",
     "blocks_needed",
     "init_paged_cache",
     "paged_cache_specs",
@@ -54,6 +68,7 @@ __all__ = [
     "quantize_kv",
     "KVExport",
     "PagedEngine",
+    "PendingSwap",
     "Request",
     "Scheduler",
 ]

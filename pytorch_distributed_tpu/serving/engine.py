@@ -40,8 +40,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_tpu.serving.kv_pool import (
+    SWAPPING_IN,
+    SWAPPING_OUT,
     TRASH_BLOCK,
     BlockAllocator,
+    HostBlockStore,
+    HostChain,
     blocks_needed,
     init_paged_cache,
     paged_cache_specs,
@@ -63,6 +67,19 @@ class ChunkJob(NamedTuple):
     start: int
     is_last: bool
     last_idx: int
+
+
+class PendingSwap(NamedTuple):
+    """A swap-out mid-flight: the chain's blocks gathered on-device with
+    their async d2h copy started (``swap_out_begin``), awaiting the host
+    materialization + store commit (``swap_out_finish``). While one of
+    these exists the chain is ``swapping-out`` in the allocator — its
+    blocks stay owned and its slot must not be recycled."""
+
+    slot: int
+    chain_len: int
+    blocks: object  # cache-shaped pytree, [n_pad, block_len, ...] device
+    logits_row: object  # [vocab_size] device
 
 
 class KVExport(NamedTuple):
@@ -111,7 +128,8 @@ class PagedEngine:
                  n_blocks: Optional[int] = None, block_len: int = 16,
                  prefill_chunk: int = 128, temperature: float = 0.0,
                  top_k: Optional[int] = None, mesh=None, device=None,
-                 handoff: bool = False, gather_impl: Optional[str] = None,
+                 handoff: bool = False, swap: bool = False,
+                 gather_impl: Optional[str] = None,
                  kv_dtype: Optional[str] = None):
         from pytorch_distributed_tpu.models.generate import (
             _validate_sampling,
@@ -175,6 +193,14 @@ class PagedEngine:
         self.handoff = handoff
         self._export_fns: Dict[int, callable] = {}
         self._import_fns: Dict[int, callable] = {}
+        # host-offload swap programs (round 13 pressure tier), the
+        # mirror of the handoff pair but pointed at host RAM instead of
+        # another replica's pool: gated by ``swap=`` for the same
+        # coverage-guard reason, one program pair per pow2 chain bucket.
+        self.swap = swap
+        self._swap_out_fns: Dict[int, callable] = {}
+        self._swap_in_fns: Dict[int, callable] = {}
+        self._per_block_bytes: Optional[int] = None
         # buckets whose program has EXECUTED at least once (call path hot:
         # the next call pays zero compile/load) — run_chunks/decode and the
         # execute-mode warmups add to these; AOT-only warmup does not (the
@@ -417,6 +443,66 @@ class PagedEngine:
             cache_aval, logits_aval, blocks, idx, slot, row
         ).compile()
 
+    @staticmethod
+    def swap_out_program_name(n_pad: int) -> str:
+        return f"kv_swap_out[n={n_pad}]"
+
+    @staticmethod
+    def swap_in_program_name(n_pad: int) -> str:
+        return f"kv_swap_in[n={n_pad}]"
+
+    def swap_buckets(self) -> List[int]:
+        """Every chain-length bucket the swap programs can compile for —
+        the same pow2-clipped range as the handoff buckets (both walk
+        chains the admission contract bounded by ``table_width``). Empty
+        unless the engine was built with ``swap=True``, so pressure-less
+        registries predict no swap programs."""
+        if not self.swap:
+            return []
+        ns, n = [], 1
+        while n < self.table_width:
+            ns.append(n)
+            n <<= 1
+        ns.append(self.table_width)
+        return sorted(set(ns))
+
+    def warm_swap_out(self, n_pad: int, execute: bool = True):
+        """Compile (and inertly run) one swap-out gather bucket: reads
+        the trash block and slot 0's logits row, mutating nothing — the
+        same inert contract as ``warm_export``. ``execute=False``
+        returns the ``Compiled`` (cost-card statics)."""
+        fn = self._swap_out_fn(n_pad)
+        idx = jnp.full((n_pad,), TRASH_BLOCK, jnp.int32)
+        slot = jnp.asarray(0, jnp.int32)
+        if execute:
+            fn(self.cache, self.logits, idx, slot)
+            return None
+        cache_aval, logits_aval = self._cache_logits_avals()
+        return fn.lower(cache_aval, logits_aval, idx, slot).compile()
+
+    def warm_swap_in(self, n_pad: int, execute: bool = True):
+        """Compile (and inertly run) one swap-in scatter bucket: every
+        lane scatters into the trash block and the logits row targets
+        the out-of-bounds ``n_slots`` sentinel (dropped) — live state is
+        untouched. ``execute=False`` returns the ``Compiled``."""
+        fn = self._swap_in_fn(n_pad)
+        blocks = jax.tree.map(
+            lambda pool: jnp.zeros((n_pad,) + pool.shape[1:], pool.dtype),
+            self.cache,
+        )
+        idx = jnp.full((n_pad,), TRASH_BLOCK, jnp.int32)
+        slot = jnp.asarray(self.n_slots, jnp.int32)
+        row = jnp.zeros((self.config.vocab_size,), self.logits.dtype)
+        if execute:
+            self.cache, self.logits = fn(
+                self.cache, self.logits, blocks, idx, slot, row,
+            )
+            return None
+        cache_aval, logits_aval = self._cache_logits_avals()
+        return fn.lower(
+            cache_aval, logits_aval, blocks, idx, slot, row
+        ).compile()
+
     def has_chunk_program(self, k_pad: int, wp: int) -> bool:
         """True when the bucket's call path is hot (executed before)."""
         return (k_pad, wp) in self._hot_chunks
@@ -435,6 +521,10 @@ class PagedEngine:
                   sorted(self._export_fns)]
         names += [self.import_program_name(n) for n in
                   sorted(self._import_fns)]
+        names += [self.swap_out_program_name(n) for n in
+                  sorted(self._swap_out_fns)]
+        names += [self.swap_in_program_name(n) for n in
+                  sorted(self._swap_in_fns)]
         return names
 
     def _cache_logits_avals(self):
@@ -662,6 +752,180 @@ class PagedEngine:
         )
         self.tables[slot] = TRASH_BLOCK
         self.tables[slot, :export.n_blocks] = chain
+        return True
+
+    # ---- host-offload swap (round 13 pressure tier) ----
+
+    def _require_swap(self):
+        if not self.swap:
+            raise RuntimeError(
+                "this engine was built without swap=True — its registry "
+                "does not predict kv_swap_out/kv_swap_in programs "
+                "(offload-enabled schedulers set it)"
+            )
+
+    def _swap_out_fn(self, n_pad: int):
+        fn = self._swap_out_fns.get(n_pad)
+        if fn is not None:
+            return fn
+
+        def body(cache, logits, idx, slot):
+            blocks = jax.tree.map(lambda pool: pool[idx], cache)
+            return blocks, logits[slot]
+
+        fn = jax.jit(body)  # pure read: nothing donated
+        self._swap_out_fns[n_pad] = fn
+        return fn
+
+    def _swap_in_fn(self, n_pad: int):
+        fn = self._swap_in_fns.get(n_pad)
+        if fn is not None:
+            return fn
+
+        def body(cache, logits, blocks, idx, slot, row):
+            cache = jax.tree.map(
+                lambda pool, b: pool.at[idx].set(b), cache, blocks
+            )
+            return cache, logits.at[slot].set(row)
+
+        fn = jax.jit(body, donate_argnums=(0, 1))
+        self._swap_in_fns[n_pad] = fn
+        return fn
+
+    def chain_bytes(self, n_blocks: int) -> int:
+        """Device bytes ``n_blocks`` pool blocks hold across every cache
+        leaf (K + V + scale siblings) plus one logits row — the payload
+        a swap moves, and the byte side of the swap-vs-recompute
+        decision. Pure shape arithmetic on the live pool (computed once,
+        cached)."""
+        if self._per_block_bytes is None:
+            total = sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree.leaves(self.cache)
+            )
+            self._per_block_bytes = total // self.allocator.n_blocks
+        row = self.logits.size * self.logits.dtype.itemsize // self.n_slots
+        return n_blocks * self._per_block_bytes + row
+
+    def swap_out_begin(self, slot: int) -> PendingSwap:
+        """Open a swap-out window on ``slot``'s chain: ONE compiled
+        gather (per chain-length bucket) detaches the chain's blocks and
+        the slot's logits row, and their async d2h copy starts. The
+        chain stays allocated and marked ``swapping-out`` — nothing is
+        freed until ``swap_out_finish`` commits the host copy, so a
+        failure anywhere in the window leaves the stream resident and
+        bit-intact."""
+        self._require_swap()
+        chain = self.allocator.chain(slot)
+        if not chain:
+            raise ValueError(f"slot {slot} holds no block chain to swap")
+        self.allocator.set_state(slot, SWAPPING_OUT)
+        n_pad = self._chain_bucket(len(chain))
+        idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
+        idx[:len(chain)] = chain
+        blocks, row = self._swap_out_fn(n_pad)(
+            self.cache, self.logits, jnp.asarray(idx),
+            jnp.asarray(slot, jnp.int32),
+        )
+        for leaf in jax.tree.leaves(blocks) + [row]:
+            try:
+                leaf.copy_to_host_async()  # overlap d2h with serving
+            except AttributeError:
+                pass
+        return PendingSwap(slot=slot, chain_len=len(chain), blocks=blocks,
+                           logits_row=row)
+
+    def swap_out_finish(self, pending: PendingSwap, store: HostBlockStore,
+                        rid: int) -> HostChain:
+        """Close the swap-out window: materialize the d2h copy, commit
+        the ``HostChain`` to ``store`` under ``rid``, then — and only
+        then — free the device chain and trash the slot's table row.
+
+        Hazard sites (``resilience.faults``): ``kv.swap_out_d2h`` before
+        the host materialization, ``kv.host_write`` before the store
+        commit. ANY failure up to the commit re-raises with the window
+        closed and the chain still resident — the caller re-arms the
+        lane and the stream continues as if nothing happened."""
+        from pytorch_distributed_tpu.resilience.faults import fault_point
+
+        slot = pending.slot
+        try:
+            fault_point("kv.swap_out_d2h")
+            blocks = jax.tree.map(
+                lambda b: np.asarray(
+                    jax.device_get(b))[:pending.chain_len],
+                pending.blocks,
+            )
+            row = np.asarray(jax.device_get(pending.logits_row))
+            nbytes = row.nbytes + sum(
+                b.nbytes for b in jax.tree.leaves(blocks)
+            )
+            chain = HostChain(blocks=blocks, logits_row=row,
+                              n_blocks=pending.chain_len,
+                              block_len=self.block_len, nbytes=nbytes)
+            fault_point("kv.host_write")
+            if not store.put(rid, chain):
+                raise OSError(
+                    f"host store rejected rid {rid}'s chain "
+                    f"({nbytes} bytes over budget)"
+                )
+        except BaseException:
+            # window closed, chain untouched: the stream stays resident
+            self.allocator.clear_state(slot)
+            raise
+        self.allocator.clear_state(slot)
+        self.release(slot)
+        return chain
+
+    def swap_in_chain(self, slot: int, chain: HostChain) -> bool:
+        """Restore a host chain into ``slot``: allocate fresh blocks,
+        h2d the payload onto the pool's placement, scatter with ONE
+        donated program (per bucket), and remap the table. Returns False
+        (state unchanged) when the pool cannot supply the chain — the
+        caller keeps the host copy and retries, the ``admit`` contract.
+
+        Hazard site ``kv.swap_in_h2d`` fires before any device write: a
+        failure there frees the fresh chain and re-raises with the host
+        copy intact — the restore is retryable, never half-applied."""
+        from pytorch_distributed_tpu.resilience.faults import fault_point
+
+        self._require_swap()
+        if chain.block_len != self.block_len:
+            raise ValueError(
+                f"cannot swap block_len={chain.block_len} blocks into "
+                f"a block_len={self.block_len} pool"
+            )
+        ids = self.allocator.alloc(slot, chain.n_blocks)
+        if ids is None:
+            return False
+        self.allocator.set_state(slot, SWAPPING_IN)
+        n_pad = self._chain_bucket(chain.n_blocks)
+        try:
+            fault_point("kv.swap_in_h2d")
+            idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
+            idx[:chain.n_blocks] = ids
+
+            def _padded(b, pool):
+                if n_pad > b.shape[0]:  # padding lanes hit the trash block
+                    pad = np.zeros((n_pad - b.shape[0],) + b.shape[1:],
+                                   b.dtype)
+                    b = np.concatenate([b, pad])
+                return jax.device_put(b, pool.sharding)
+
+            blocks = jax.tree.map(_padded, chain.blocks, self.cache)
+            row = jax.device_put(chain.logits_row, self.logits.sharding)
+            self.cache, self.logits = self._swap_in_fn(n_pad)(
+                self.cache, self.logits, blocks, jnp.asarray(idx),
+                jnp.asarray(slot, jnp.int32), row,
+            )
+        except BaseException:
+            self.allocator.clear_state(slot)
+            self.allocator.free(slot)
+            self.tables[slot] = TRASH_BLOCK
+            raise
+        self.allocator.clear_state(slot)
+        self.tables[slot] = TRASH_BLOCK
+        self.tables[slot, :chain.n_blocks] = ids
         return True
 
     def run_chunks(self, jobs: List[ChunkJob]) -> None:

@@ -20,17 +20,37 @@ Allocation is HOST-side and deterministic: a LIFO free list (freshly
 freed blocks are reused first — warmer in cache) with an explicit
 ``None`` on insufficient capacity, so the scheduler queues the request
 instead of crashing (the "deterministic OOM → queue" contract).
+
+Round 13 (KV pressure tier; ANALYSIS.md "KV pressure & preemption"):
+the pool gains a SECOND tier. A preempted request's chain can leave the
+device — a compiled gather pulls its blocks, a d2h copy lands them in a
+:class:`HostBlockStore` entry (:class:`HostChain`), and the device
+blocks return to the free list — and come back later through h2d + a
+donated scatter into a freshly allocated chain. While a chain is in
+transit the allocator tracks it through an explicit per-chain state
+machine (``resident → swapping-out → host → swapping-in → resident``):
+``free``/``release_all`` REFUSE to free a chain mid-swap, so a drain or
+teardown racing an in-flight swap is a loud error, never a corrupted
+pool.
 """
 
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 TRASH_BLOCK = 0
+
+#: chain swap states (``BlockAllocator.state``). A chain with no entry
+#: is plain resident; the transit states bracket the d2h/h2d windows.
+RESIDENT = "resident"
+SWAPPING_OUT = "swapping-out"
+SWAPPING_IN = "swapping-in"
+SWAP_STATES = (SWAPPING_OUT, SWAPPING_IN)
 
 #: pool dtypes ``init_paged_cache`` accepts: None keeps the model compute
 #: dtype (the raw layout); "int8" stores quantized K/V plus per-
@@ -90,6 +110,10 @@ class BlockAllocator:
         # hand out 1, 2, 3, ... (deterministic, test-friendly order).
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))
         self._chains: Dict[int, List[int]] = {}
+        # owner -> transit state; absent == resident. The swap windows
+        # (engine.swap_out_begin → swap_out_finish, swap_in_chain) set
+        # and clear these; free()/release_all() refuse mid-swap owners.
+        self._states: Dict[int, str] = {}
 
     @property
     def available(self) -> int:
@@ -105,6 +129,37 @@ class BlockAllocator:
     def owners(self) -> List[int]:
         """Owners currently holding a chain (drain accounting / teardown)."""
         return list(self._chains)
+
+    # ---- chain swap states (round 13: the host-offload tier) ----
+
+    def state(self, owner: int) -> str:
+        """The chain's swap state — ``resident`` unless a swap window is
+        open on it (owners without a chain are resident by definition:
+        nothing to protect)."""
+        return self._states.get(owner, RESIDENT)
+
+    def set_state(self, owner: int, state: str) -> None:
+        """Open a swap window on ``owner``'s chain. Only live chains can
+        enter transit — state on a chainless owner is a caller bug."""
+        if state not in SWAP_STATES:
+            raise ValueError(
+                f"state {state!r} must be one of {SWAP_STATES} "
+                "(use clear_state to return to resident)"
+            )
+        if owner not in self._chains:
+            raise ValueError(
+                f"owner {owner} holds no chain to mark {state}"
+            )
+        self._states[owner] = state
+
+    def clear_state(self, owner: int) -> None:
+        """Close the swap window (back to resident). Idempotent."""
+        self._states.pop(owner, None)
+
+    def swapping(self) -> List[int]:
+        """Owners with an open swap window — the set ``begin_drain``
+        must wait on before teardown."""
+        return sorted(self._states)
 
     def alloc(self, owner: int, n: int) -> Optional[List[int]]:
         """Allocate ``n`` blocks for ``owner`` (a slot id). Returns the
@@ -123,7 +178,18 @@ class BlockAllocator:
     def free(self, owner: int) -> None:
         """Release ``owner``'s chain back to the free list (LIFO reuse).
         Freeing an owner without a chain is a no-op — retirement paths
-        may race a request that never got blocks."""
+        may race a request that never got blocks. Freeing a chain with
+        an OPEN SWAP WINDOW is refused loudly: the d2h/h2d in flight
+        still reads/writes those blocks, and recycling them would
+        corrupt whichever stream reuses them first (the drain-while-
+        swapping race; tests/test_pressure.py)."""
+        state = self._states.get(owner)
+        if state is not None:
+            raise RuntimeError(
+                f"owner {owner}'s chain is {state}: finish or abort the "
+                "swap before freeing (begin_drain waits on in-flight "
+                "swaps for exactly this reason)"
+            )
         chain = self._chains.pop(owner, None)
         if chain:
             self._free.extend(reversed(chain))
@@ -231,3 +297,96 @@ def paged_cache_specs(config, cache):
         lambda leaf, spec: spec if leaf.ndim == 4 else P(*tuple(spec)[:3]),
         cache, specs,
     )
+
+
+# ---------------------------------------------------------------------------
+# host tier (round 13: pressure offload)
+# ---------------------------------------------------------------------------
+
+
+class HostChain(NamedTuple):
+    """One request's KV chain at rest in host RAM: the pool pytree
+    sliced to the chain (numpy leaves, logical positions in chain order)
+    plus the slot's logits row — the next token's distribution, without
+    which a swapped-in decode lane could not resume bit-exact. Block ids
+    do NOT travel (same contract as the fleet handoff's ``KVExport``):
+    swap-in allocates a fresh chain and remaps the table."""
+
+    blocks: object  # cache-shaped pytree of numpy [n_blocks, block_len, ...]
+    logits_row: object  # numpy [vocab_size]
+    n_blocks: int
+    block_len: int
+    nbytes: int
+
+
+class HostBlockStore:
+    """Host-RAM tier for swapped-out chains, keyed by request id.
+
+    Plain pageable host memory stands in for pinned buffers on this
+    backend (jax's d2h lands in numpy either way); the store's job is
+    bookkeeping with teeth: exact byte accounting, an optional
+    ``max_bytes`` budget (``put`` returns False when a chain does not
+    fit — the caller's cue to recompute instead), and a lock so a
+    future threaded swap path inherits a safe store
+    (``analysis/rules_threads.py`` vets the discipline)."""
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self.max_bytes = max_bytes
+        self._chains: Dict[int, HostChain] = {}
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def has_room(self, nbytes: int) -> bool:
+        """Whether a chain of ``nbytes`` would fit the budget now — the
+        swap-vs-recompute decision consults this BEFORE gathering, so a
+        full store steers preemption to recompute instead of failing the
+        swap mid-flight."""
+        if self.max_bytes is None:
+            return True
+        with self._lock:
+            return self._bytes + nbytes <= self.max_bytes
+
+    def put(self, rid: int, chain: HostChain) -> bool:
+        """Store one chain; False (store unchanged) when over budget.
+        Storing twice for one rid is a caller bug — a parked request has
+        exactly one host copy."""
+        with self._lock:
+            if rid in self._chains:
+                raise ValueError(f"rid {rid} already has a host chain")
+            if (self.max_bytes is not None
+                    and self._bytes + chain.nbytes > self.max_bytes):
+                return False
+            self._chains[rid] = chain
+            self._bytes += chain.nbytes
+            return True
+
+    def get(self, rid: int) -> HostChain:
+        with self._lock:
+            return self._chains[rid]
+
+    def pop(self, rid: int) -> HostChain:
+        """Remove and return — called only AFTER a successful swap-in,
+        so a failed h2d leaves the host copy intact and retryable."""
+        with self._lock:
+            chain = self._chains.pop(rid)
+            self._bytes -= chain.nbytes
+            return chain
+
+    def __contains__(self, rid: int) -> bool:
+        with self._lock:
+            return rid in self._chains
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._chains)
+
+    def rids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._chains)

@@ -34,6 +34,21 @@ Pass ``metrics_log`` (a ``MetricsLogger``) to stream one ``kind=
 ``tracer`` (a ``telemetry.SpanTracer``) for admission / prefill_chunk /
 decode_tick spans.
 
+KV pressure tier (round 13; ANALYSIS.md "KV pressure & preemption"):
+``offload=True`` arms the second tier — ``preempt(rid)`` parks a
+decode-armed request (LRU-idle victims first via ``preempt_lru``),
+choosing per request between swapping its chain to a host-RAM
+``HostBlockStore`` (compiled gather → async d2h, finalized next tick)
+and recomputing from the prompt (chain dropped now; the streamed tokens
+re-prefill as prompt at restore) by a MEASURED cost comparison
+(``telemetry.costmodel.swap_vs_recompute``: chain bytes through the
+probed link vs resume chunks times the chunk program's measured wall).
+``_restore_parked`` restores FIFO before each tick's admissions — a
+preempted request resumes before its next decode, token-identical
+either way. ``preempt_on_oom`` lets admission preempt one victim per
+stuck queue head; the fleet ``SLOGate``'s preempt rung drives the same
+entry point to turn sheds into preemptions.
+
 Fleet integration (round 10; ``fleet/``, ANALYSIS.md "Serving fleet"):
 one Scheduler is one *replica*. ``replica_id`` stamps every JSONL
 record; ``device`` commits the replica's engine to its own sub-mesh
@@ -104,6 +119,19 @@ class Request:
     # affinity replica by the SLO gate — both land in the JSONL record
     session: Optional[int] = None
     spilled: bool = False
+    # ---- pressure tier (round 13; offload schedulers only) ----
+    # the submitted prompt's length — ``tokens`` grows on a recompute
+    # restore (generated tokens re-prefill as prompt), so the JSONL's
+    # prompt_len reports THIS, not len(tokens)
+    orig_len: int = -1
+    # tokens this request has streamed, kept only under offload: the
+    # recompute path re-prefills them as prompt so the stream resumes
+    # bit-exact from where it was preempted
+    generated: Optional[List[int]] = None
+    # preempt/restore accounting + the anti-thrash protection window
+    # (a just-restored request cannot be re-victimized before this tick)
+    preempts: int = 0
+    protect_until: int = -1
 
     @property
     def length(self) -> int:
@@ -128,8 +156,20 @@ class Scheduler:
                  handoff: bool = False, flightrec=None,
                  anomaly_threshold: float = 8.0,
                  gather_impl: Optional[str] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 offload: bool = False, preempt_on_oom: bool = False,
+                 swap_policy: str = "auto", protect_ticks: int = 2,
+                 host_store=None,
+                 host_store_max_bytes: Optional[int] = None):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
+        from pytorch_distributed_tpu.serving.kv_pool import HostBlockStore
+
+        if swap_policy not in ("auto", "swap", "recompute"):
+            raise ValueError(
+                f"swap_policy {swap_policy!r} must be auto|swap|recompute"
+            )
+        if preempt_on_oom and not offload:
+            raise ValueError("preempt_on_oom needs offload=True")
 
         if eos_id is not None and not 0 <= eos_id < config.vocab_size:
             raise ValueError(
@@ -143,9 +183,37 @@ class Scheduler:
             config, params, n_slots, n_blocks=n_blocks, block_len=block_len,
             prefill_chunk=prefill_chunk, temperature=temperature,
             top_k=top_k, mesh=mesh, device=device,
-            handoff=(handoff or prefill_only),
+            handoff=(handoff or prefill_only), swap=offload,
             gather_impl=gather_impl, kv_dtype=kv_dtype,
         )
+        # ---- pressure tier (round 13): host offload + preemption ----
+        self.offload = offload
+        self.preempt_on_oom = preempt_on_oom
+        self.swap_policy = swap_policy
+        self.protect_ticks = protect_ticks
+        self.host_store = (
+            host_store if host_store is not None
+            else HostBlockStore(max_bytes=host_store_max_bytes)
+        )
+        # rid -> (request, restore path): preempted requests awaiting
+        # restore, FIFO (dict preserves insertion order)
+        self.parked: Dict[int, Tuple[Request, str]] = {}
+        # swap-outs whose d2h window is open: finalized at the top of
+        # the next step() (and by begin_drain) — the real cross-tick
+        # swapping-out state
+        self._swapping: List[tuple] = []
+        # slots whose chain is mid-swap-out: not reusable until finish
+        self._swap_slots: set = set()
+        self._preempts = 0
+        self._restores = 0
+        self._swap_outs = 0
+        self._swap_ins = 0
+        self._swap_aborts = 0
+        self._swap_bytes = 0
+        self._decision_swap = 0
+        self._decision_recompute = 0
+        self._oom_preempted_for: Optional[int] = None
+        self.swap_lat = LatencySeries("swap")
         # the engine may have replaced gather_impl= into the config —
         # read back its copy so scheduler and programs agree
         self.config = self.engine.config
@@ -306,12 +374,16 @@ class Scheduler:
         self.queue.append(Request(
             rid=rid, tokens=prompt, max_new_tokens=max_new_tokens,
             submit_step=self._step_count, submit_time=time.perf_counter(),
-            session=session, spilled=spilled,
+            session=session, spilled=spilled, orig_len=l,
+            generated=[] if self.offload else None,
         ))
         return rid
 
     def _free_slots(self) -> List[int]:
-        return [s for s in range(self.n_slots) if s not in self.resident]
+        # a slot whose chain is mid-swap-out is NOT free: its table row
+        # and allocator chain are still live until the swap finalizes
+        return [s for s in range(self.n_slots)
+                if s not in self.resident and s not in self._swap_slots]
 
     def _admit(self) -> None:
         """Admit up to ``admit_per_step`` queue-head requests that can be
@@ -326,7 +398,22 @@ class Scheduler:
             req = self.queue[0]
             slot = free[0]
             if not self.engine.admit(slot, req.length, req.max_new_tokens):
-                break  # pool OOM: queue (blocks free as others retire)
+                # pool OOM: queue (blocks free as others retire). Under
+                # pressure mode, first preempt one LRU victim — its
+                # blocks free now (recompute) or next tick (swap), so
+                # capacity turns over instead of waiting on a retire.
+                # ONE preemption per stuck queue head: restores outrank
+                # admissions (strict arrival order — a parked request is
+                # older than the queue head), so preempting every tick
+                # would only carousel chains through the host store;
+                # one boost per head keeps the pressure valve open
+                # without the thrash.
+                if (self.preempt_on_oom
+                        and not self.parked and not self._swapping
+                        and self._oom_preempted_for != req.rid):
+                    if self.preempt_lru(reason="admission-oom") is not None:
+                        self._oom_preempted_for = req.rid
+                break
             self.queue.popleft()
             free.pop(0)
             req.slot = slot
@@ -343,6 +430,276 @@ class Scheduler:
                 "admit", rid=req.rid, slot=slot, replica=self.replica_id
             )
             admitted += 1
+
+    # ---- pressure tier: preempt, park, restore (round 13) ----------------
+
+    def _victims(self) -> List[Tuple[float, int, int]]:
+        """Eligible preemption victims, LRU-idle first: decode-armed
+        resident requests (mid-prefill chains and handoff-parked
+        ``ready`` requests are not preemptible), outside their post-
+        restore protection window, not already mid-swap. Sorted by last
+        token wall time (admit time for lanes yet to produce) so the
+        stream that has gone longest without a token — the idlest
+        conversation — pays first."""
+        if not self.offload:
+            return []
+        import math as _math
+
+        out = []
+        for slot, req in self.resident.items():
+            if req.prefill_done < req.length or slot in self._swap_slots:
+                continue
+            if req.rid in self.ready:
+                continue  # held for fleet handoff, not ours to park
+            if self._step_count < req.protect_until:
+                continue
+            last = req.last_token_time
+            if _math.isnan(last):
+                last = req.admit_time
+            out.append((last, req.rid, slot))
+        out.sort()
+        return out
+
+    def _swap_decision(self, req: Request, slot: int):
+        """The per-request swap-vs-recompute verdict: the chain's bytes
+        through the measured link vs the resume-prefill's chunks times
+        the chunk program's measured wall (``telemetry.costmodel``),
+        then the hard constraints — a resume sequence the table cannot
+        hold forces swap, a host store without room forces recompute.
+        Returns None when neither path is viable (the request is simply
+        not preemptible right now)."""
+        import dataclasses as _dc
+
+        from pytorch_distributed_tpu.telemetry.costmodel import (
+            swap_vs_recompute,
+        )
+
+        chain_len = len(self.engine.allocator.chain(slot))
+        bytes_to_move = self.engine.chain_bytes(chain_len)
+        seq_len = req.length + len(req.generated or ())
+        c = self.engine.chunk
+        chunks = -(-seq_len // c)
+        # the chunk program a recompute would run: the measured mean
+        # wall of any hot chunk bucket (the cost-card join side —
+        # buckets differ by padding, not asymptotics; None when nothing
+        # has measured yet and the decision falls to its default)
+        chunk_wall = None
+        for prog, (n, s) in self.prog_times.items():
+            if prog.startswith("chunk_prefill[") and n > 0:
+                chunk_wall = s / n
+                break
+        decision = swap_vs_recompute(
+            bytes_to_move, chunks=chunks, chunk_wall_s=chunk_wall,
+        )
+        if self.swap_policy != "auto":
+            decision = _dc.replace(decision, choice=self.swap_policy,
+                                   reason=f"forced-{self.swap_policy}")
+        # hard constraints override the cost verdict
+        padded = -(-seq_len // c) * c
+        need = self.engine.blocks_for(seq_len,
+                                      req.max_new_tokens - req.produced)
+        can_recompute = (
+            padded <= self.config.max_seq_len
+            and need <= min(self.engine.table_width,
+                            self.engine.allocator.n_blocks - 1)
+        )
+        store_ok = self.host_store.has_room(bytes_to_move)
+        if decision.choice == "recompute" and not can_recompute:
+            decision = _dc.replace(decision, choice="swap",
+                                   reason="recompute-overflows-table")
+        elif decision.choice == "swap" and not store_ok:
+            if not can_recompute:
+                return None
+            decision = _dc.replace(decision, choice="recompute",
+                                   reason="host-store-full")
+        return decision
+
+    def preempt_lru(self, reason: str = "pressure") -> Optional[int]:
+        """Preempt the least-recently-served eligible victim; returns
+        its rid (None when nothing is preemptible — the caller's cue
+        that shedding really is the last resort)."""
+        for _, rid, _slot in self._victims():
+            if self.preempt(rid, reason=reason) is not None:
+                return rid
+        return None
+
+    def preempt(self, rid: int, reason: str = "pressure"):
+        """Park request ``rid``: its decision picks swap (chain leaves
+        for the host store through the compiled gather + d2h) or
+        recompute (chain dropped now, the stream's tokens re-prefill as
+        prompt at restore). Either way the lane stops decoding THIS tick
+        and the request is restored — before its next decode — by
+        ``_restore_parked`` once capacity allows. Returns the
+        ``SwapDecision`` (None when the request is not preemptible)."""
+        slot = next(
+            (s for s, r in self.resident.items() if r.rid == rid), None
+        )
+        if slot is None:
+            raise ValueError(f"rid {rid} is not resident")
+        req = self.resident[slot]
+        if req.prefill_done < req.length:
+            raise ValueError(f"rid {rid} is mid-prefill: not preemptible")
+        decision = self._swap_decision(req, slot)
+        if decision is None:
+            return None
+        if decision.choice == "recompute":
+            del self.resident[slot]
+            self.remaining[slot] = 0
+            self.engine.release(slot)
+            self.parked[rid] = (req, "recompute")
+            self._decision_recompute += 1
+        else:
+            pending = self.engine.swap_out_begin(slot)
+            del self.resident[slot]
+            self.remaining[slot] = 0
+            self._swap_slots.add(slot)
+            self._swapping.append(
+                (rid, req, pending, time.perf_counter(), decision)
+            )
+            self._decision_swap += 1
+        req.preempts += 1
+        self._preempts += 1
+        self.flightrec.record(
+            "preempt", rid=rid, slot=slot, reason=reason,
+            decision=decision.choice, replica=self.replica_id,
+        )
+        if self.metrics_log is not None:
+            self.metrics_log.log(
+                kind="preempt", rid=rid, replica_id=self.replica_id,
+                reason=reason, decision=decision.choice,
+                decision_reason=decision.reason,
+                predicted_swap_s=decision.swap_s,
+                predicted_recompute_s=decision.recompute_s,
+                bytes=decision.bytes_to_move, chunks=decision.chunks,
+                produced=req.produced, queue_depth=len(self.queue),
+            )
+        return decision
+
+    def _finalize_swaps(self) -> None:
+        """Close every open swap-out window: materialize the d2h copy,
+        commit the host chain, free the device chain. A failure at
+        either hazard site (``kv.swap_out_d2h``, ``kv.host_write``)
+        REVERTS the preemption — the chain never left, so the lane is
+        re-armed and the stream continues bit-exact."""
+        if not self._swapping:
+            return
+        pending, self._swapping = self._swapping, []
+        for rid, req, pend, t0, decision in pending:
+            slot = pend.slot
+            try:
+                chain = self.engine.swap_out_finish(
+                    pend, self.host_store, rid
+                )
+            except OSError as e:
+                # revert: chain untouched on device; re-arm the lane
+                self.resident[slot] = req
+                self.remaining[slot] = req.max_new_tokens - req.produced
+                self._swap_slots.discard(slot)
+                self._swap_aborts += 1
+                self.flightrec.record(
+                    "swap_abort", rid=rid, direction="out", error=str(e),
+                    replica=self.replica_id,
+                )
+                if self.metrics_log is not None:
+                    self.metrics_log.log(
+                        kind="swap", rid=rid, replica_id=self.replica_id,
+                        direction="out", ok=False, error=str(e),
+                    )
+                continue
+            wall = time.perf_counter() - t0
+            self._swap_slots.discard(slot)
+            self.parked[rid] = (req, "swap")
+            self._swap_outs += 1
+            self._swap_bytes += chain.nbytes
+            self.swap_lat.observe(wall)
+            self.flightrec.record(
+                "swap", rid=rid, direction="out", bytes=chain.nbytes,
+                replica=self.replica_id,
+            )
+            if self.metrics_log is not None:
+                self.metrics_log.log(
+                    kind="swap", rid=rid, replica_id=self.replica_id,
+                    direction="out", ok=True, bytes=chain.nbytes,
+                    wall_s=round(wall, 6),
+                    predicted_s=decision.swap_s,
+                )
+
+    def _restore_parked(self) -> None:
+        """Restore parked requests FIFO, before this tick's admissions
+        (a preempted request outranks a queued one — it already earned
+        its admission). Swap path: fresh chain + h2d + donated scatter,
+        lane re-armed at its exact frontier. Recompute path: the
+        stream's tokens join the prompt and the request re-prefills —
+        the final chunk's logits row reproduces the exact next-token
+        distribution, so greedy streams resume token-identical either
+        way. A restore that cannot proceed (no slot, no chain, injected
+        h2d fault) leaves the request parked and retries next tick."""
+        for rid in list(self.parked):
+            req, path = self.parked[rid]
+            free = self._free_slots()
+            if not free:
+                break
+            slot = free[0]
+            t0 = time.perf_counter()
+            if path == "swap":
+                chain = self.host_store.get(rid)
+                try:
+                    if not self.engine.swap_in_chain(slot, chain):
+                        break  # no chain free: retry when blocks return
+                except OSError as e:
+                    self._swap_aborts += 1
+                    self.flightrec.record(
+                        "swap_abort", rid=rid, direction="in",
+                        error=str(e), replica=self.replica_id,
+                    )
+                    if self.metrics_log is not None:
+                        self.metrics_log.log(
+                            kind="swap", rid=rid,
+                            replica_id=self.replica_id,
+                            direction="in", ok=False, error=str(e),
+                        )
+                    break  # host copy intact; retry next tick
+                self.host_store.pop(rid)
+                wall = time.perf_counter() - t0
+                self._swap_ins += 1
+                self._swap_bytes += chain.nbytes
+                self.swap_lat.observe(wall)
+                if self.metrics_log is not None:
+                    self.metrics_log.log(
+                        kind="swap", rid=rid, replica_id=self.replica_id,
+                        direction="in", ok=True, bytes=chain.nbytes,
+                        wall_s=round(wall, 6),
+                    )
+                del self.parked[rid]
+                req.slot = slot
+                self.resident[slot] = req
+                self.positions[slot] = req.length + req.produced
+                self.remaining[slot] = req.max_new_tokens - req.produced
+            else:  # recompute: generated tokens re-prefill as prompt
+                seq = req.tokens
+                if req.generated:
+                    seq = np.concatenate([
+                        req.tokens,
+                        np.asarray(req.generated, np.int32),
+                    ])
+                if not self.engine.admit(
+                    slot, len(seq), req.max_new_tokens - req.produced
+                ):
+                    break  # pool OOM: retry when blocks return
+                del self.parked[rid]
+                req.tokens = seq
+                req.generated = []  # consumed into the prompt
+                req.prefill_done = 0
+                req.slot = slot
+                self.resident[slot] = req
+                self.positions[slot] = 0
+                self.remaining[slot] = 0  # armed by its final chunk
+            req.protect_until = self._step_count + self.protect_ticks
+            self._restores += 1
+            self.flightrec.record(
+                "restore", rid=rid, slot=slot, path=path,
+                replica=self.replica_id,
+            )
 
     def _chunk_jobs(self):
         from pytorch_distributed_tpu.serving.engine import ChunkJob
@@ -370,6 +727,13 @@ class Scheduler:
         if self._start_time is None:
             self._start_time = time.perf_counter()
         t_step0 = time.perf_counter()
+        if self.offload:
+            # pressure tier: close last tick's swap-out windows (their
+            # blocks return to the pool), then restore parked requests
+            # BEFORE admitting new ones — a preempted request resumes
+            # ahead of the queue, before its next decode tick
+            self._finalize_swaps()
+            self._restore_parked()
         with self.tracer.span("admission", queued=len(self.queue)):
             self._admit()
         jobs = self._chunk_jobs()
@@ -408,7 +772,12 @@ class Scheduler:
                     if self.prefill_only:
                         self.ready[req.rid] = j.slot
                     else:
-                        self.remaining[j.slot] = req.max_new_tokens
+                        # produced > 0 only after a recompute restore:
+                        # the re-prefilled stream resumes what is left
+                        # of its original decode budget
+                        self.remaining[j.slot] = (
+                            req.max_new_tokens - req.produced
+                        )
         active = self.remaining > 0
         self._occupancy_sum += len(self.resident) / self.n_slots
         self._step_count += 1
@@ -460,6 +829,10 @@ class Scheduler:
                 self.token_lat.observe(gap)
             req.last_token_time = now
             req.produced += 1
+            if req.generated is not None:
+                # offload mode keeps the stream so a recompute restore
+                # can re-prefill it as prompt
+                req.generated.append(token)
             self._tokens_out += 1
             if (self.eos_id is not None and token == self.eos_id) or \
                     req.produced >= req.max_new_tokens:
@@ -510,8 +883,9 @@ class Scheduler:
             rejected=False,
             session=req.session,
             spilled=req.spilled,
-            prompt_len=req.length,
+            prompt_len=req.orig_len if req.orig_len >= 0 else req.length,
             new_tokens=req.produced,
+            preempts=req.preempts,
             cold=req.cold,
             queue_wait_s=round(req.admit_time - req.submit_time, 6),
             ttft_s=round(req.first_token_time - req.submit_time, 6),
@@ -520,12 +894,20 @@ class Scheduler:
             token_gaps_s=[round(g, 6) for g in req.token_gaps],
         )
 
+    @property
+    def idle(self) -> bool:
+        """Nothing queued, resident, parked, or mid-swap — the drain
+        loops' (and the fleet router's) termination condition; a parked
+        request is in-flight work, not absence of it."""
+        return (not self.queue and not self.resident
+                and not self.parked and not self._swapping)
+
     def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Step until queue and lanes are empty; returns
         ``{rid: [tokens]}``."""
         produced: Dict[int, List[int]] = {}
         for _ in range(max_steps):
-            if not self.queue and not self.resident:
+            if self.idle:
                 return produced
             for rid, tok in self.step():
                 produced.setdefault(rid, []).append(tok)
@@ -539,7 +921,16 @@ class Scheduler:
     def begin_drain(self) -> None:
         """Stop admitting: ``submit`` raises, ``step`` skips admission.
         In-flight requests keep decoding to completion; the queue is
-        frozen for ``drain_graceful`` to hand back to the router."""
+        frozen for ``drain_graceful`` to hand back to the router.
+
+        Waits for in-flight swap-outs first (the drain-while-swapping
+        race): a chain mid-d2h must either commit to the host store or
+        revert to resident before any teardown path may free blocks —
+        the allocator would refuse to free a ``swapping-out`` chain
+        anyway (loudly), so closing the windows here keeps drains both
+        safe AND quiet."""
+        if self.offload:
+            self._finalize_swaps()
         self.draining = True
 
     def drain_graceful(
@@ -562,8 +953,12 @@ class Scheduler:
         self.queue.clear()
         produced: Dict[int, List[int]] = {}
         for _ in range(max_steps):
-            if not self.resident or (
+            # parked/mid-swap requests are in-flight (they were already
+            # admitted once): the drain restores and finishes them too
+            if (not self.resident and not self.parked
+                    and not self._swapping) or (
                 self.prefill_only
+                and not self.parked and not self._swapping
                 and all(r.rid in self.ready
                         for r in self.resident.values())
             ):
@@ -717,6 +1112,24 @@ class Scheduler:
             # warm-only TTFT is the SLO series, plain ttft includes cold
             "cold_requests": self._cold_requests,
             "compile_s": self.goodput.seconds("compile"),
+            # pressure tier (round 13): what the SLO gate's preempt rung
+            # reads (offload capability + eligible victims right now)
+            # and the swap machinery's exact counters
+            "offload": self.offload,
+            "preemptible": len(self._victims()),
+            "parked": len(self.parked),
+            "preempts": self._preempts,
+            "restores": self._restores,
+            "swap_outs": self._swap_outs,
+            "swap_ins": self._swap_ins,
+            "swap_aborts": self._swap_aborts,
+            "swap_bytes": self._swap_bytes,
+            "decision_swap": self._decision_swap,
+            "decision_recompute": self._decision_recompute,
+            "host_store_bytes": (
+                self.host_store.bytes_used if self.offload else 0
+            ),
+            **self.swap_lat.summary("swap"),
             # anomaly sentinel (telemetry/anomaly.py): total hits and the
             # recency flag the fleet SLOGate treats as hot
             "anomaly_count": (

@@ -56,9 +56,12 @@ from pytorch_distributed_tpu.telemetry.anomaly import (
 from pytorch_distributed_tpu.telemetry.costmodel import (
     CostCard,
     ProgramTimes,
+    SwapDecision,
     build_cost_cards,
     device_ceilings,
+    link_bandwidth,
     log_cost_cards,
+    swap_vs_recompute,
 )
 from pytorch_distributed_tpu.telemetry.device_metrics import DeviceMetricsRing
 from pytorch_distributed_tpu.telemetry.export import (
@@ -81,9 +84,12 @@ __all__ = [
     "StreamingDetector",
     "CostCard",
     "ProgramTimes",
+    "SwapDecision",
     "build_cost_cards",
     "device_ceilings",
+    "link_bandwidth",
     "log_cost_cards",
+    "swap_vs_recompute",
     "DeviceMetricsRing",
     "MetricsExporter",
     "prometheus_text",

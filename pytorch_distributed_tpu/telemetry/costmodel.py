@@ -75,6 +75,120 @@ def device_ceilings(device_kind: Optional[str] = None):
     return DEVICE_CEILINGS.get(device_kind, (None, None))
 
 
+#: env overrides for the host↔device link (GB/s), the PDT_PEAK_* knob
+#: family extended to the swap path: CI pins these to steer the
+#: swap-vs-recompute decision deterministically on the CPU backend.
+LINK_ENV_H2D = "PDT_PEAK_H2D_GBS"
+LINK_ENV_D2H = "PDT_PEAK_D2H_GBS"
+
+_link_cache: Optional[Tuple[float, float]] = None
+
+
+def link_bandwidth(probe_mb: int = 4,
+                   reps: int = 3) -> Tuple[Optional[float], Optional[float]]:
+    """``(h2d_bytes_s, d2h_bytes_s)`` of the host↔device link.
+
+    Env overrides ``PDT_PEAK_H2D_GBS``/``PDT_PEAK_D2H_GBS`` first
+    (deterministic CI), else ONE measured probe per process — a
+    ``probe_mb`` buffer put/get round (median of ``reps``), the in-tree
+    twin of ``scripts/bench_serving.py``'s ``link_probe`` — cached
+    module-global so the serve loop never re-pays it. A backend that
+    cannot run the probe yields ``(None, None)``: the decision degrades
+    to its stated default, never crashes."""
+    global _link_cache
+    h2d_env = os.environ.get(LINK_ENV_H2D)
+    d2h_env = os.environ.get(LINK_ENV_D2H)
+    if h2d_env and d2h_env:
+        return float(h2d_env) * 1e9, float(d2h_env) * 1e9
+    if _link_cache is None:
+        try:
+            import time
+
+            import jax
+            import numpy as np
+
+            buf = np.ones(probe_mb << 20, np.uint8)
+
+            def med(f):
+                times = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    f()
+                    times.append(time.perf_counter() - t0)
+                return max(float(np.median(times)), 1e-9)
+
+            dev = jax.block_until_ready(jax.device_put(buf))  # warm path
+            h2d_s = med(
+                lambda: jax.block_until_ready(jax.device_put(buf))
+            )
+            d2h_s = med(lambda: np.asarray(jax.device_get(dev)))
+            _link_cache = (buf.nbytes / h2d_s, buf.nbytes / d2h_s)
+        except Exception:
+            _link_cache = (0.0, 0.0)  # probe failed: remembered as unknown
+    h2d = float(h2d_env) * 1e9 if h2d_env else (_link_cache[0] or None)
+    d2h = float(d2h_env) * 1e9 if d2h_env else (_link_cache[1] or None)
+    return h2d, d2h
+
+
+@dataclasses.dataclass(frozen=True)
+class SwapDecision:
+    """One preemption's swap-vs-recompute verdict, with the predicted
+    costs that produced it — logged verbatim (``kind="preempt"``) so the
+    crossover is auditable against measured walls after the fact."""
+
+    choice: str  # "swap" | "recompute"
+    swap_s: Optional[float]
+    recompute_s: Optional[float]
+    bytes_to_move: int
+    chunks: int
+    reason: str
+
+
+def swap_vs_recompute(
+    bytes_to_move: int,
+    *,
+    chunks: int = 0,
+    chunk_wall_s: Optional[float] = None,
+    h2d_bytes_s: Optional[float] = None,
+    d2h_bytes_s: Optional[float] = None,
+) -> SwapDecision:
+    """The measured crossover (vLLM's preemption choice, with this
+    repo's numbers in it): predicted swap cost is the chain's bytes
+    through the MEASURED link both ways (d2h now + h2d at restore);
+    predicted recompute cost is the resume-prefill's chunk count times
+    the chunk program's MEASURED per-call wall (``ProgramTimes`` — the
+    cost-card join, not a FLOP guess). Link rates default from
+    ``link_bandwidth()`` (env-overridable). When one side is
+    unmeasurable the other wins; when neither is, swap is the stated
+    default (same-host d2h/h2d is cheap everywhere this repo runs;
+    recompute burns accelerator FLOPs the pool is starved for)."""
+    if h2d_bytes_s is None or d2h_bytes_s is None:
+        h2d0, d2h0 = link_bandwidth()
+        h2d_bytes_s = h2d_bytes_s if h2d_bytes_s is not None else h2d0
+        d2h_bytes_s = d2h_bytes_s if d2h_bytes_s is not None else d2h0
+    swap_s = (
+        bytes_to_move * (1.0 / h2d_bytes_s + 1.0 / d2h_bytes_s)
+        if h2d_bytes_s and d2h_bytes_s else None
+    )
+    recompute_s = (
+        chunks * chunk_wall_s
+        if chunk_wall_s is not None and chunks > 0 else None
+    )
+    if swap_s is None and recompute_s is None:
+        choice, reason = "swap", "unmeasured-default"
+    elif recompute_s is None:
+        choice, reason = "swap", "recompute-unmeasured"
+    elif swap_s is None:
+        choice, reason = "recompute", "link-unmeasured"
+    else:
+        choice = "swap" if swap_s <= recompute_s else "recompute"
+        reason = "measured-crossover"
+    return SwapDecision(choice=choice, swap_s=swap_s,
+                        recompute_s=recompute_s,
+                        bytes_to_move=int(bytes_to_move), chunks=chunks,
+                        reason=reason)
+
+
 def extract_costs(compiled) -> dict:
     """Static cost fields from a ``jax.stages.Compiled`` (or ``Lowered``).
 

@@ -45,6 +45,15 @@ seeded bursty heavy-tail traffic trace (``scripts/bench_serving.py
         --slo-ttft-ms 500 --metrics-out fleet.jsonl
     python recipes/serve_lm.py --tiny --replicas 2 --disaggregate
 
+KV pressure (round 13; ANALYSIS.md "KV pressure & preemption"):
+``--preempt`` turns memory pressure into preemptions instead of waits
+or sheds — idle chains swap to a host-RAM block store (or recompute,
+whichever the measured cost card says is cheaper) and restore before
+their next tick; ``--n-blocks`` sizes the pool small to provoke it:
+
+    python recipes/serve_lm.py --tiny --requests 24 --slots 4 \
+        --n-blocks 12 --preempt --metrics-out pressure.jsonl
+
 Cold start (round 8; ANALYSIS.md "Cold start & compile cache"):
 ``--warmup`` compiles every registry program (decode tick + all prefill
 buckets) before admitting traffic, and ``--compile-cache-dir`` points
@@ -92,6 +101,23 @@ def _parse() -> argparse.Namespace:
                    help="decode budget per request")
     p.add_argument("--block-len", type=int, default=16,
                    help="KV block length (paged layout)")
+    p.add_argument("--n-blocks", type=int, default=None,
+                   help="KV pool size in blocks (default: capacity "
+                        "parity with the dense layout; set it SMALL to "
+                        "over-commit the pool and exercise the round-13 "
+                        "pressure tier)")
+    # KV pressure tier (round 13; ANALYSIS.md "KV pressure & preemption")
+    p.add_argument("--preempt", action="store_true",
+                   help="enable the KV pressure tier: host-RAM offload "
+                        "+ preempt-and-restore. Single scheduler: pool "
+                        "OOM preempts the LRU resident chain instead of "
+                        "making the queue wait for a retirement. Fleet: "
+                        "the SLO gate's preempt rung turns would-be "
+                        "sheds into cheap preemptions")
+    p.add_argument("--slo-shed-depth", type=int, default=None,
+                   help="fleet shed queue depth (with --preempt the "
+                        "gate preempts instead of shedding at this "
+                        "bound; spill bound is set to a quarter of it)")
     p.add_argument("--prefill-chunk", type=int, default=32,
                    help="prefill chunk length (paged) / bucket (dense)")
     p.add_argument("--admit-per-step", type=int, default=4,
@@ -256,9 +282,15 @@ def main() -> None:
             replay_trace,
         )
 
-        slo = (
-            SLOConfig(ttft_p95_ms=args.slo_ttft_ms)
-            if args.slo_ttft_ms is not None else SLOConfig()
+        slo_kw = {}
+        if args.slo_ttft_ms is not None:
+            slo_kw["ttft_p95_ms"] = args.slo_ttft_ms
+        if args.slo_shed_depth is not None:
+            slo_kw["shed_queue_depth"] = args.slo_shed_depth
+            slo_kw["spill_queue_depth"] = max(1, args.slo_shed_depth // 4)
+        slo = SLOConfig(**slo_kw)
+        pressure_kw = (
+            dict(offload=True, preempt_on_oom=True) if args.preempt else {}
         )
         router = FleetRouter(
             cfg, params, n_replicas=max(args.replicas, 2)
@@ -267,8 +299,9 @@ def main() -> None:
             n_prefill=args.prefill_replicas, slo=slo, seed=args.seed,
             metrics_log=mlog, tracer=tracer, n_slots=args.slots,
             block_len=args.block_len, prefill_chunk=args.prefill_chunk,
-            admit_per_step=args.admit_per_step,
+            admit_per_step=args.admit_per_step, n_blocks=args.n_blocks,
             gather_impl=args.gather_impl, kv_dtype=args.kv_dtype,
+            **pressure_kw,
         )
         if args.warmup:
             router.warmup()
@@ -318,6 +351,10 @@ def main() -> None:
         if args.gather_impl or args.kv_dtype:
             raise SystemExit("--gather-impl/--kv-dtype are block-pool "
                              "knobs; drop --dense")
+        if args.preempt or args.n_blocks is not None:
+            raise SystemExit("--preempt/--n-blocks are block-pool knobs "
+                             "(the pressure tier swaps BLOCKS); drop "
+                             "--dense")
         if args.tp > 1:
             raise SystemExit("--tp > 1 needs the paged layout; drop "
                              "--dense")
@@ -337,10 +374,11 @@ def main() -> None:
     else:
         s = Scheduler(
             cfg, params, n_slots=args.slots, block_len=args.block_len,
-            prefill_chunk=args.prefill_chunk,
+            prefill_chunk=args.prefill_chunk, n_blocks=args.n_blocks,
             admit_per_step=args.admit_per_step, seed=args.seed,
             mesh=mesh, tracer=tracer, metrics_log=mlog,
             gather_impl=args.gather_impl, kv_dtype=args.kv_dtype,
+            offload=args.preempt, preempt_on_oom=args.preempt,
         )
         if args.warmup:
             # everything foreground + executed inert: the serve loop below

@@ -20,6 +20,14 @@ Usage: python scripts/bench_serving.py [--slots 32]
        python scripts/bench_serving.py --disagg [--trace T.jsonl]  # colo vs PD
        python scripts/bench_serving.py --gather-ab [--tiny --ab-slots 8
            --ab-ticks 32 --ab-prompt-len 64]  # pallas-vs-dense + int8 capacity
+       python scripts/bench_serving.py --pressure [--pressure-sessions 100000
+           --pressure-blocks 13 --pressure-duration 90]  # preempt vs shed-only
+
+Round 13 (pressure tier): ``--pressure`` replays one over-committed
+bursty trace (default 100k session ids on a pool holding ~3 chains per
+replica) through a shed-only fleet vs the same fleet with host offload
++ the SLO gate's preempt rung, and reports within-SLO goodput, shed
+rates, preempt/restore counts, and swap p95 (``serving_pressure_*``).
 
 Round 10 (fleet/): ``--gen-trace`` emits the reusable seeded
 bursty/heavy-tail JSONL trace; ``--fleet`` replays ONE trace through a
@@ -712,6 +720,80 @@ def measure_gather_ab(slots: int = 8, ticks: int = 32, prompt_len: int = 64,
     }
 
 
+def measure_pressure(trace=None, slots: int = 4, n_blocks: int = 13,
+                     sessions: int = 100_000,
+                     duration_s: float = 90.0) -> dict:
+    """The pressure-tier A/B (ISSUE 11): ONE over-committed bursty trace
+    (sessions ≫ pool chains — default 100k session ids over a pool that
+    holds ~3 chains per replica) served by (a) a shed-only fleet (the
+    pre-round-13 ladder: queue then reject) and (b) the same fleet with
+    the KV pressure tier on (host offload + the SLO gate's preempt
+    rung). The headline is goodput — completed tokens per nominal
+    second whose step-domain TTFT met the SLO (same accounting as
+    ``measure_fleet``) — plus the shed rates the preempt rung exists to
+    zero and the measured swap walls behind the decision model."""
+    from pytorch_distributed_tpu.fleet import SLOConfig, generate_trace
+    from pytorch_distributed_tpu.telemetry import percentiles
+
+    cfg, params = _tiny_model()
+    if trace is None:
+        trace = generate_trace(
+            seed=0, duration_s=duration_s, base_rate=0.7,
+            burst_rate_mult=4.0, burst_every_s=20.0, burst_len_s=4.0,
+            sessions=sessions,
+            prompt_median=24, prompt_sigma=0.8, prompt_min=4,
+            prompt_max=96, max_new_median=10, max_new_sigma=0.6,
+            max_new_min=2, max_new_max=24,
+        )
+    slo = SLOConfig(spill_queue_depth=2, shed_queue_depth=8)
+    shed_only, rec_s, _, ticks_s = _replay_fleet(
+        cfg, params, trace, 2, slo=slo, slots=slots, n_blocks=n_blocks,
+    )
+    pressured, rec_p, _, ticks_p = _replay_fleet(
+        cfg, params, trace, 2, slo=slo, slots=slots, n_blocks=n_blocks,
+        offload=True, preempt_on_oom=True,
+    )
+    ms, mp = shed_only.metrics(), pressured.metrics()
+
+    def ttft_ticks_p95(records):
+        ps = percentiles(
+            [r["ttft_steps"] for r in records
+             if r.get("kind") == "request" and "ttft_steps" in r],
+            qs=(95,),
+        )
+        return ps.get("p95", 0.0)
+
+    slo_ttft_ticks = 3.0 * max(ttft_ticks_p95(rec_p), 1.0)
+    g_shed = _goodput_tok_per_s(rec_s, ticks_s, 1.0, slo_ttft_ticks)
+    g_pre = _goodput_tok_per_s(rec_p, ticks_p, 1.0, slo_ttft_ticks)
+    swaps = [r for r in rec_p if r.get("kind") == "swap" and r.get("ok")]
+    swap_walls = [r["wall_s"] for r in swaps if "wall_s" in r]
+    swap_p95 = percentiles(swap_walls, qs=(95,)).get("p95", 0.0)
+    return {
+        "serving_pressure_trace_requests": len(trace),
+        "serving_pressure_sessions": sessions,
+        "serving_pressure_pool_blocks": n_blocks,
+        "serving_pressure_slo_ttft_ticks": round(slo_ttft_ticks, 1),
+        "serving_pressure_goodput_tok_s_shed_only": round(g_shed, 2),
+        "serving_pressure_goodput_tok_s_preempt": round(g_pre, 2),
+        "serving_pressure_goodput_ratio": round(
+            g_pre / max(g_shed, 1e-9), 2
+        ),
+        "serving_pressure_shed_rate_shed_only": round(
+            ms["shed_rate"], 4
+        ),
+        "serving_pressure_shed_rate_preempt": round(mp["shed_rate"], 4),
+        "serving_pressure_sheds_preempt": mp["shed"],
+        "serving_pressure_preempts": mp["preempts"],
+        "serving_pressure_restores": mp["restores"],
+        "serving_pressure_swap_mib": round(
+            mp["swap_bytes"] / 2**20, 2
+        ),
+        "serving_pressure_swap_p95_ms": round(swap_p95 * 1e3, 3),
+        "device": str(jax.devices()[0]),
+    }
+
+
 def link_probe(mb: int = 16, reps: int = 5) -> dict:
     """Same-run bandwidth/link probe, co-quoted with every serving bench
     row (ISSUE 8, ADVICE §6 — the ckpt bench's same-minute disk-probe
@@ -809,6 +891,15 @@ def main() -> None:
         return
     if "--disagg" in sys.argv:
         print(json.dumps({**measure_disagg(trace=_cli_trace()), **probe}))
+        return
+    if "--pressure" in sys.argv:
+        print(json.dumps({**measure_pressure(
+            trace=_cli_trace(),
+            slots=_argval("--pressure-slots", 4, int),
+            n_blocks=_argval("--pressure-blocks", 13, int),
+            sessions=_argval("--pressure-sessions", 100_000, int),
+            duration_s=_argval("--pressure-duration", 90.0),
+        ), **probe}))
         return
     if "--stall" in sys.argv:
         print(json.dumps({**measure_admission_stall(slots), **probe}))

@@ -9,7 +9,7 @@
 #                             --resilience-smoke|--serving-smoke|
 #                             --telemetry-smoke|--warmup-smoke|--reshard-smoke|
 #                             --fleet-smoke|--obs-smoke|--kernel-smoke|
-#                             --bench-regression]
+#                             --pressure-smoke|--bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -61,6 +61,14 @@
 # --cost-cards — then telemetry_report.py must render the per-program
 # MFU/roofline table and >=1 anomaly (--require cost,anomaly) and the
 # flight-recorder dump must parse (~30 s).
+#
+# --pressure-smoke: lint, then the round-13 KV pressure cycle: one
+# short over-committed serve (2-replica fleet, a pool holding ~3 chains
+# per replica, bursty trace, tight shed bound, --preempt) must finish
+# with >=1 preempt AND >=1 restore AND ZERO sheds (the preempt rung
+# replacing the reject), then telemetry_report.py must render the
+# pressure section (--require pressure: preempt rate, swap p95,
+# decision crossover) from the JSONL alone (~30 s).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -165,6 +173,33 @@ if [[ "${1:-}" == "--warmup-smoke" ]]; then
         --json "$smoke/coldstart.json"
     JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
         "$smoke/cc/warmup_manifest.jsonl" --json --require warmup
+    exit 0
+fi
+
+if [[ "${1:-}" == "--pressure-smoke" ]]; then
+    echo "== pressure smoke (over-committed serve -> preempt+restore, zero sheds) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py \
+        --gen-trace "$smoke/trace.jsonl" --trace-duration 30 \
+        --trace-base-rate 0.7 --trace-prompt-max 88
+    JAX_PLATFORMS=cpu python recipes/serve_lm.py --tiny --replicas 2 \
+        --slots 4 --n-blocks 13 --max-new 8 --preempt \
+        --slo-shed-depth 4 --trace "$smoke/trace.jsonl" \
+        --metrics-out "$smoke/pressure.jsonl"
+    python - "$smoke/pressure.jsonl" <<'PY'
+import json, sys
+records = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+fleet = [r for r in records if r.get("kind") == "fleet_summary"][-1]
+assert fleet["shed"] == 0, f"pressure tier shed {fleet['shed']} requests"
+assert fleet["preempts"] >= 1, "over-committed cycle never preempted"
+assert fleet["restores"] >= 1, "no preempted request was restored"
+assert fleet["restores"] == fleet["preempts"], fleet
+print(f"pressure: {fleet['preempts']} preempts, {fleet['restores']} "
+      f"restores, 0 sheds, {fleet['swap_bytes']} swap bytes")
+PY
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/pressure.jsonl" --json --require pressure
     exit 0
 fi
 

@@ -26,7 +26,11 @@ produces, from the JSONL alone:
   ceiling is known; set PDT_PEAK_FLOPS / PDT_PEAK_GBS);
 - the **anomaly section** (round 11; ``telemetry/anomaly.py``) — count
   per series plus the latest excursions with their z-scores, from
-  ``kind="anomaly"`` records.
+  ``kind="anomaly"`` records;
+- the **pressure section** (round 13; KV offload + preemption) —
+  preempt rate, per-direction swap p50/p95 and bytes moved, swap-vs-
+  recompute decision counts and the predicted-cost crossover histogram,
+  from ``kind="preempt"``/``kind="swap"`` records.
 
 Usage:
     python scripts/telemetry_report.py RUN.jsonl [SERVE.jsonl ...] [--json]
@@ -340,6 +344,78 @@ def cost_section(records: List[dict], out: dict) -> List[str]:
     return lines
 
 
+def pressure_section(records: List[dict], out: dict) -> List[str]:
+    """KV pressure tier (round 13; ``serving/`` offload + preemption):
+    preempt rate, swap walls, and the swap-vs-recompute decision
+    crossover, from ``kind="preempt"`` / ``kind="swap"`` records."""
+    preempts = [r for r in records if r.get("kind") == "preempt"]
+    swaps = [r for r in records if r.get("kind") == "swap"]
+    if not preempts and not swaps:
+        return []
+    lines = ["== kv pressure =="]
+    reqs = [r for r in records
+            if r.get("kind") == "request" and not r.get("rejected")]
+    rate = len(preempts) / len(reqs) if reqs else 0.0
+    by_choice: dict = {}
+    for r in preempts:
+        by_choice[r.get("decision", "?")] = (
+            by_choice.get(r.get("decision", "?"), 0) + 1
+        )
+    lines.append(
+        f"  {len(preempts)} preemptions"
+        + (f" over {len(reqs)} requests ({rate:.1%})" if reqs else "")
+        + "; decisions: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(by_choice.items())
+        )
+    )
+    out["pressure_preempts"] = len(preempts)
+    out["pressure_preempt_rate"] = round(rate, 4)
+    out["pressure_decision_swap"] = by_choice.get("swap", 0)
+    out["pressure_decision_recompute"] = by_choice.get("recompute", 0)
+    ok = [r for r in swaps if r.get("ok")]
+    fails = [r for r in swaps if not r.get("ok")]
+    out["pressure_swap_aborts"] = len(fails)
+    for direction in ("out", "in"):
+        walls = [r["wall_s"] for r in ok
+                 if r.get("direction") == direction and "wall_s" in r]
+        if not walls:
+            continue
+        ps = percentiles(walls, qs=(50, 95))
+        moved = sum(r.get("bytes", 0) for r in ok
+                    if r.get("direction") == direction)
+        lines.append(_fmt_row(
+            f"swap_{direction}", f"{len(walls)}x",
+            f"p50 {ps['p50'] * 1e3:.2f}ms",
+            f"p95 {ps['p95'] * 1e3:.2f}ms",
+            f"{moved / 2**20:.2f}MiB",
+        ))
+        out[f"pressure_swap_{direction}_p95_ms"] = round(
+            ps["p95"] * 1e3, 3
+        )
+        out[f"pressure_swap_{direction}_bytes"] = moved
+    # decision-crossover histogram: predicted swap/recompute cost ratio
+    # per preemption, bucketed in octaves around the crossover at 1 —
+    # shows WHERE on the curve this workload's preemptions landed
+    ratios = [
+        r["predicted_swap_s"] / r["predicted_recompute_s"]
+        for r in preempts
+        if r.get("predicted_swap_s") and r.get("predicted_recompute_s")
+    ]
+    if ratios:
+        edges = (0.25, 0.5, 1.0, 2.0, 4.0)
+        labels = ["<1/4x", "1/4-1/2x", "1/2-1x", "1-2x", "2-4x", ">4x"]
+        counts = [0] * (len(edges) + 1)
+        for v in ratios:
+            i = sum(v >= e for e in edges)
+            counts[i] += 1
+        lines.append("  swap/recompute predicted-cost crossover: "
+                     + ", ".join(f"{l}={c}" for l, c in
+                                 zip(labels, counts) if c))
+        for l, c in zip(labels, counts):
+            out[f"pressure_crossover_{l}"] = c
+    return lines
+
+
 def anomaly_section(records: List[dict], out: dict) -> List[str]:
     """Sentinel hits (``kind="anomaly"``): per-series counts and the
     latest excursions with their z-scores and baselines."""
@@ -374,10 +450,11 @@ def main(argv=None) -> int:
                    help="append one flat JSON dict (bench.py style)")
     p.add_argument("--require", default=None,
                    help="comma list of sections that MUST be present "
-                        "(goodput, serving, warmup, fleet, cost, "
-                        "anomaly) — exit non-zero otherwise; the "
+                        "(goodput, serving, warmup, fleet, pressure, "
+                        "cost, anomaly) — exit non-zero otherwise; the "
                         "ci_check.sh --telemetry-smoke, --warmup-smoke, "
-                        "--fleet-smoke and --obs-smoke gates")
+                        "--fleet-smoke, --obs-smoke and "
+                        "--pressure-smoke gates")
     args = p.parse_args(argv)
 
     records = load_records(args.paths)
@@ -388,6 +465,7 @@ def main(argv=None) -> int:
     lines += train_section(records, out)
     lines += serving_section(records, out)
     lines += fleet_section(records, out)
+    lines += pressure_section(records, out)
     lines += cost_section(records, out)
     lines += anomaly_section(records, out)
     if not lines:
@@ -399,13 +477,14 @@ def main(argv=None) -> int:
         "serving": "serving_ttft_p50_ms" in out,
         "warmup": "warmup_programs" in out,
         "fleet": "fleet_replicas" in out,
+        "pressure": out.get("pressure_preempts", 0) > 0,
         "cost": out.get("cost_programs", 0) > 0,
         "anomaly": out.get("anomalies", 0) > 0,
     }
     if not any(present.values()):
         print("no goodput record, serving latencies, warmup manifest, "
-              "fleet records, cost cards, or anomalies found",
-              file=sys.stderr)
+              "fleet records, pressure records, cost cards, or anomalies "
+              "found", file=sys.stderr)
         return 2
     required = {s for s in (args.require or "").split(",") if s}
     unknown = required - set(present)
