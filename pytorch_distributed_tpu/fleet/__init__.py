@@ -32,6 +32,7 @@ from pytorch_distributed_tpu.fleet.admission import (
     SLOConfig,
     SLOGate,
     recommend_replicas,
+    trace_decision,
 )
 from pytorch_distributed_tpu.fleet.router import FleetRouter
 from pytorch_distributed_tpu.fleet.traffic import (
@@ -53,6 +54,7 @@ __all__ = [
     "SLOConfig",
     "SLOGate",
     "recommend_replicas",
+    "trace_decision",
     "FleetRouter",
     "TraceRequest",
     "clamp_trace",

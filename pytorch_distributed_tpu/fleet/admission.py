@@ -205,6 +205,37 @@ class SLOGate:
         return Decision(SHED, -1, "queue_depth")  # unreachable guard
 
 
+def trace_decision(reqtrace, rid: int, decision: Decision, *,
+                   session: Optional[int] = None,
+                   preferred: Optional[int] = None,
+                   prompt_len: Optional[int] = None) -> int:
+    """Open ``rid``'s lifecycle trace at the gate decision (round 14;
+    ``telemetry.reqtrace``).
+
+    The admission decision is the request's first causal fact — every
+    later span (queue wait, prefill, handoff, decode, preemption) hangs
+    under the root this opens. Each decision lands as one tagged
+    ``gate`` event: ``action`` ∈ {admit, spill, preempt, shed} plus the
+    reason the affinity replica was left (a queue-on-hot-fleet admit is
+    an admit whose reason names the SLO signal — the "queue"
+    backpressure rung). A shed CLOSES the root immediately: the trace
+    is complete, outcome ``shed``, and ``--assert-complete`` holds for
+    rejected requests too. Returns the root span id."""
+    root = reqtrace.open_root(
+        rid, session=session, prompt_len=prompt_len
+    )
+    reqtrace.event(
+        rid, "gate", parent=root,
+        action=decision.action,
+        target=decision.replica,
+        reason=decision.reason or None,
+        preferred=preferred,
+    )
+    if decision.action == SHED:
+        reqtrace.end(root, outcome="shed", reason=decision.reason)
+    return root
+
+
 def recommend_replicas(
     n_now: int,
     metrics: Sequence[dict],

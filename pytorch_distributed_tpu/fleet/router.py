@@ -57,6 +57,7 @@ from pytorch_distributed_tpu.fleet.admission import (
     SLOConfig,
     SLOGate,
     recommend_replicas,
+    trace_decision,
 )
 from pytorch_distributed_tpu.serving.scheduler import Scheduler
 from pytorch_distributed_tpu.telemetry import LatencySeries, percentiles
@@ -79,10 +80,13 @@ class FleetRouter:
                  handoffs_per_tick: Optional[int] = None,
                  slo: Optional[SLOConfig] = None, devices=None,
                  seed: int = 0, metrics_log=None, tracer=None,
-                 flightrec=None, **scheduler_kwargs):
+                 flightrec=None, reqtrace=None, **scheduler_kwargs):
         import jax
 
-        from pytorch_distributed_tpu.telemetry import NULL_RECORDER
+        from pytorch_distributed_tpu.telemetry import (
+            NULL_RECORDER,
+            NULL_REQTRACER,
+        )
 
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -102,6 +106,11 @@ class FleetRouter:
         # handoffs — land in the shared flight-recorder ring, so a
         # post-mortem dump shows WHY requests went where before death
         self.flightrec = flightrec if flightrec is not None else NULL_RECORDER
+        # request-lifecycle tracing (round 14): ONE shared ReqTracer
+        # across every replica, so a request's spans stay one tree as it
+        # crosses the admission gate, the prefill replica, the handoff,
+        # and the decode replica
+        self.reqtrace = reqtrace if reqtrace is not None else NULL_REQTRACER
         self.replicas: List[Scheduler] = []
         self.roles: List[str] = []
         for i in range(n_replicas):
@@ -127,7 +136,8 @@ class FleetRouter:
                 config, params, replica_id=i, seed=seed + i,
                 prefill_only=(role == "prefill"), device=dev,
                 handoff=disaggregate, metrics_log=metrics_log,
-                tracer=tracer, flightrec=self.flightrec, **kw,
+                tracer=tracer, flightrec=self.flightrec,
+                reqtrace=self.reqtrace, **kw,
             ))
             self.roles.append(role)
         self.disaggregated = disaggregate
@@ -179,6 +189,15 @@ class FleetRouter:
         decision = self.gate.route(
             self._group_metrics(self.entry_group), preferred
         )
+        if self.reqtrace.enabled:
+            # the gate decision opens the request's root span — the
+            # first causal fact of its lifecycle (a shed closes it
+            # right here: complete trace, outcome=shed)
+            trace_decision(
+                self.reqtrace, rid, decision, session=session,
+                preferred=preferred,
+                prompt_len=int(np.asarray(prompt).size),
+            )
         if decision.action == SHED:
             self.rejected[rid] = decision.reason
             self.flightrec.record("shed", rid=rid, reason=decision.reason)
@@ -236,6 +255,7 @@ class FleetRouter:
             key=lambda i: (len(self.replicas[i].resident),
                            len(self.replicas[i].queue)),
         )
+        preempted_this_pump = False
         for pi in self.entry_group:
             ps = self.replicas[pi]
             for rid in ps.ready_rids():
@@ -248,11 +268,49 @@ class FleetRouter:
                      if self.replicas[di].adopt(req, export)), None,
                 )
                 if adopted_by is None:
-                    break  # no decode capacity this tick; retry later
+                    # no decode capacity this tick. Under the pressure
+                    # tier, park ONE idle decode chain (LRU) so next
+                    # tick's pump can adopt — the handoff twin of the
+                    # SLO gate's preempt rung: a prefill-complete
+                    # request stalling on a full decode pool is the same
+                    # over-commit the admission path preempts for. One
+                    # victim per pump (anti-thrash); the request stays
+                    # parked here, blocks intact, and retries.
+                    if not preempted_this_pump:
+                        for di in order:
+                            if not self.replicas[di].offload:
+                                continue
+                            victim = self.replicas[di].preempt_lru(
+                                reason="handoff-pressure"
+                            )
+                            if victim is not None:
+                                preempted_this_pump = True
+                                self._preempt_routes += 1
+                                self.flightrec.record(
+                                    "preempt_route", rid=rid, to=di,
+                                    victim=victim,
+                                    reason="handoff-pressure",
+                                )
+                                break
+                    break
                 ps.complete_handoff(rid)
-                self.handoff_lat.observe(time.perf_counter() - t0)
+                wall = time.perf_counter() - t0
+                self.handoff_lat.observe(wall)
                 self.placement[rid] = adopted_by
                 self._handoff_count += 1
+                if self.reqtrace.enabled:
+                    # the handoff as a span of its own (backdated to the
+                    # export), plus a flow link to the decode window it
+                    # enabled on the other replica — peek/adopt/complete
+                    # become visible parent→child structure in the trace
+                    h = self.reqtrace.begin(
+                        rid, "handoff", replica=pi, t=t0, src=pi,
+                        dst=adopted_by, blocks=export.n_blocks,
+                        bytes=ps.engine.chain_bytes(export.n_blocks),
+                    )
+                    self.reqtrace.end(h, wall_s=round(wall, 6))
+                    self.reqtrace.link(rid, h, req.span_decode,
+                                       "handoff")
                 self.flightrec.record(
                     "handoff", rid=rid, src=pi, dst=adopted_by
                 )

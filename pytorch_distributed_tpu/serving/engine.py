@@ -608,6 +608,16 @@ class PagedEngine:
         return blocks_needed(prompt_len, max_new_tokens, self.block_len,
                              self.chunk)
 
+    def set_kv_trace(self, observer) -> None:
+        """Install ``observer(event, owner, info)`` on this engine's
+        block allocator (``BlockAllocator.on_transition``): every chain
+        alloc/free and swap-state change — wherever it originates
+        (admission, retirement, handoff import, either swap direction) —
+        reports through it. The round-14 request-lifecycle traces hang
+        their KV chain-identity events off this hook; pass ``None`` to
+        detach."""
+        self.allocator.on_transition = observer
+
     def admit(self, slot: int, prompt_len: int, max_new_tokens: int) -> bool:
         """Allocate ``slot``'s block chain and write its table row — the
         O(1)-ish host half of admission (the device half is the chunk

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, List, NamedTuple, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -114,6 +114,16 @@ class BlockAllocator:
         # (engine.swap_out_begin → swap_out_finish, swap_in_chain) set
         # and clear these; free()/release_all() refuse mid-swap owners.
         self._states: Dict[int, str] = {}
+        #: optional transition observer ``(event, owner, info)`` fired on
+        #: alloc / free / swap-state changes — chain identity for the
+        #: round-14 request-lifecycle traces (``telemetry.reqtrace``; the
+        #: scheduler installs an adapter mapping owner slot → rid). Must
+        #: never raise into the allocator; observers are forensics.
+        self.on_transition: Optional[Callable[[str, int, dict], None]] = None
+
+    def _notify(self, event: str, owner: int, **info) -> None:
+        if self.on_transition is not None:
+            self.on_transition(event, owner, info)
 
     @property
     def available(self) -> int:
@@ -151,10 +161,14 @@ class BlockAllocator:
                 f"owner {owner} holds no chain to mark {state}"
             )
         self._states[owner] = state
+        self._notify("state", owner, state=state,
+                     n_blocks=len(self._chains[owner]))
 
     def clear_state(self, owner: int) -> None:
         """Close the swap window (back to resident). Idempotent."""
-        self._states.pop(owner, None)
+        if self._states.pop(owner, None) is not None:
+            self._notify("state", owner, state=RESIDENT,
+                         n_blocks=len(self._chains.get(owner, ())))
 
     def swapping(self) -> List[int]:
         """Owners with an open swap window — the set ``begin_drain``
@@ -173,6 +187,7 @@ class BlockAllocator:
             return None  # deterministic OOM: the caller queues
         chain = [self._free.pop() for _ in range(n)]
         self._chains[owner] = chain
+        self._notify("alloc", owner, n_blocks=n, free=len(self._free))
         return list(chain)
 
     def free(self, owner: int) -> None:
@@ -193,6 +208,8 @@ class BlockAllocator:
         chain = self._chains.pop(owner, None)
         if chain:
             self._free.extend(reversed(chain))
+            self._notify("free", owner, n_blocks=len(chain),
+                         free=len(self._free))
 
 
 def init_paged_cache(config, params, n_blocks: int, block_len: int,

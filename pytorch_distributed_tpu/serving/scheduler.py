@@ -60,6 +60,17 @@ instead of arming decode, and ``peek_ready``/``complete_handoff`` +
 ``adopt`` move a request's KV blocks into a decode replica's pool
 (``PagedEngine.export_chain``/``import_chain``) — the disaggregated
 prefill/decode split.
+
+Lifecycle tracing (round 14; ANALYSIS.md "Request-lifecycle tracing"):
+pass ``reqtrace`` (a ``telemetry.ReqTracer``) and every request becomes
+one causal span tree — queued → prefill (per-chunk events naming the
+bucket program) → decode windows → retire, with preempt/park/restore as
+a sub-tree carrying the swap decision's predicted costs next to the
+measured swap walls, ``handoff_wait`` bridging into the fleet router's
+handoff span, and KV chain transitions (alloc/free/swap states)
+annotated through the ``BlockAllocator.on_transition`` adapter.
+``scripts/explain_request.py`` reconstructs any rid's story from the
+resulting ``kind="span"`` JSONL.
 """
 
 from __future__ import annotations
@@ -75,6 +86,7 @@ import numpy as np
 from pytorch_distributed_tpu.compilecache.aot import attribute_compile
 from pytorch_distributed_tpu.telemetry import (
     NULL_RECORDER,
+    NULL_REQTRACER,
     NULL_TRACER,
     AnomalySentinel,
     GoodputLedger,
@@ -132,6 +144,19 @@ class Request:
     # (a just-restored request cannot be re-victimized before this tick)
     preempts: int = 0
     protect_until: int = -1
+    # ---- request-lifecycle trace spans (round 14; telemetry/reqtrace).
+    # Span ids of this request's currently-open lifecycle spans (0 ==
+    # none). They live on the Request because the request OBJECT crosses
+    # replica boundaries on the disaggregated handoff — the span ids
+    # travel with it, so the decode replica closes what the prefill
+    # replica opened and the trace stays one tree.
+    span_queue: int = 0
+    span_prefill: int = 0
+    span_ready: int = 0
+    span_decode: int = 0
+    span_preempt: int = 0
+    span_parked: int = 0
+    span_swap: int = 0
 
     @property
     def length(self) -> int:
@@ -160,7 +185,8 @@ class Scheduler:
                  offload: bool = False, preempt_on_oom: bool = False,
                  swap_policy: str = "auto", protect_ticks: int = 2,
                  host_store=None,
-                 host_store_max_bytes: Optional[int] = None):
+                 host_store_max_bytes: Optional[int] = None,
+                 reqtrace=None):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
         from pytorch_distributed_tpu.serving.kv_pool import HostBlockStore
 
@@ -277,6 +303,15 @@ class Scheduler:
         # device+sync time, not bare dispatch)
         self.prog_times = ProgramTimes()
         self.flightrec = flightrec if flightrec is not None else NULL_RECORDER
+        # ---- request-lifecycle tracing (round 14; telemetry/reqtrace) ----
+        # rid-keyed span trees across every owner; the kv-transition
+        # adapter below annotates block alloc/free/swap-state changes
+        # with chain identity by mapping the allocator's owner slot back
+        # to the resident rid
+        self.reqtrace = reqtrace if reqtrace is not None else NULL_REQTRACER
+        self._slot2rid: Dict[int, int] = {}
+        if self.reqtrace.enabled:
+            self.engine.set_kv_trace(self._kv_transition)
         # anomaly sentinel over tick time / TTFT / queue depth; a recent
         # hit surfaces as metrics()["anomaly_recent"], which the fleet
         # SLOGate reads as a hot signal (spill around this replica)
@@ -334,6 +369,23 @@ class Scheduler:
         )
         return runner.run(background=background)
 
+    def _kv_transition(self, event: str, owner: int, info: dict) -> None:
+        """``BlockAllocator.on_transition`` adapter: chain transitions
+        (alloc/free/swap states) become ``kv_*`` events in the owning
+        request's lifecycle trace. ``owner`` is a slot id; the adapter
+        resolves it through ``_slot2rid`` (written just before each
+        allocating call, cleared when the chain frees) — transitions on
+        slots no request owns (warmup probes, teardown resets) are
+        silently unattributable and dropped."""
+        rid = self._slot2rid.get(owner)
+        if rid is None:
+            return
+        self.reqtrace.event(
+            rid, f"kv_{event}", replica=self.replica_id, slot=owner, **info
+        )
+        if event == "free":
+            self._slot2rid.pop(owner, None)
+
     def submit(self, prompt: np.ndarray, max_new_tokens: int, *,
                session: Optional[int] = None, spilled: bool = False,
                rid: Optional[int] = None) -> int:
@@ -371,12 +423,23 @@ class Scheduler:
             self._next_rid += 1
         else:
             self._next_rid = max(self._next_rid, rid + 1)
-        self.queue.append(Request(
+        req = Request(
             rid=rid, tokens=prompt, max_new_tokens=max_new_tokens,
             submit_step=self._step_count, submit_time=time.perf_counter(),
             session=session, spilled=spilled, orig_len=l,
             generated=[] if self.offload else None,
-        ))
+        )
+        if self.reqtrace.enabled:
+            # standalone schedulers open the root here; under a fleet the
+            # gate decision already did (open_root is idempotent) and
+            # this just hangs the queue-wait span under it
+            root = self.reqtrace.open_root(rid, prompt_len=l,
+                                           session=session)
+            req.span_queue = self.reqtrace.begin(
+                rid, "queued", parent=root, replica=self.replica_id,
+                max_new=max_new_tokens,
+            )
+        self.queue.append(req)
         return rid
 
     def _free_slots(self) -> List[int]:
@@ -397,7 +460,12 @@ class Scheduler:
         while self.queue and free and admitted < self.admit_per_step:
             req = self.queue[0]
             slot = free[0]
+            # kv-trace attribution BEFORE the allocating call: the alloc
+            # transition fires inside engine.admit and must resolve to
+            # this rid (popped right back on the OOM path)
+            self._slot2rid[slot] = req.rid
             if not self.engine.admit(slot, req.length, req.max_new_tokens):
+                self._slot2rid.pop(slot, None)
                 # pool OOM: queue (blocks free as others retire). Under
                 # pressure mode, first preempt one LRU victim — its
                 # blocks free now (recompute) or next tick (swap), so
@@ -429,6 +497,16 @@ class Scheduler:
             self.flightrec.record(
                 "admit", rid=req.rid, slot=slot, replica=self.replica_id
             )
+            if self.reqtrace.enabled:
+                self.reqtrace.end(
+                    req.span_queue, slot=slot,
+                    queue_wait_s=round(now - req.submit_time, 6),
+                )
+                req.span_queue = 0
+                req.span_prefill = self.reqtrace.begin(
+                    req.rid, "prefill", replica=self.replica_id,
+                    slot=slot, chunks=-(-req.length // self.engine.chunk),
+                )
             admitted += 1
 
     # ---- pressure tier: preempt, park, restore (round 13) ----------------
@@ -542,13 +620,39 @@ class Scheduler:
         decision = self._swap_decision(req, slot)
         if decision is None:
             return None
+        if self.reqtrace.enabled:
+            # the preempt sub-tree: the open decode window ends here
+            # (outcome=preempted) and everything until the restore —
+            # swap_out, parked, swap_in — nests under this span, with
+            # the decision's predicted costs attached for the
+            # predicted-vs-measured join
+            self.reqtrace.end(req.span_decode, outcome="preempted")
+            req.span_decode = 0
+            req.span_preempt = self.reqtrace.begin(
+                rid, "preempt", replica=self.replica_id, reason=reason,
+                decision=decision.choice,
+                decision_reason=decision.reason,
+                predicted_swap_s=decision.swap_s,
+                predicted_recompute_s=decision.recompute_s,
+                bytes=decision.bytes_to_move, chunks=decision.chunks,
+            )
         if decision.choice == "recompute":
             del self.resident[slot]
             self.remaining[slot] = 0
             self.engine.release(slot)
             self.parked[rid] = (req, "recompute")
             self._decision_recompute += 1
+            if self.reqtrace.enabled:
+                req.span_parked = self.reqtrace.begin(
+                    rid, "parked", parent=req.span_preempt,
+                    replica=self.replica_id, path="recompute",
+                )
         else:
+            if self.reqtrace.enabled:
+                req.span_swap = self.reqtrace.begin(
+                    rid, "swap_out", parent=req.span_preempt,
+                    replica=self.replica_id,
+                )
             pending = self.engine.swap_out_begin(slot)
             del self.resident[slot]
             self.remaining[slot] = 0
@@ -596,6 +700,17 @@ class Scheduler:
                 self.remaining[slot] = req.max_new_tokens - req.produced
                 self._swap_slots.discard(slot)
                 self._swap_aborts += 1
+                if self.reqtrace.enabled:
+                    self.reqtrace.end(req.span_swap, ok=False,
+                                      error=str(e))
+                    req.span_swap = 0
+                    self.reqtrace.end(req.span_preempt, outcome="aborted")
+                    req.span_preempt = 0
+                    # reverted == decoding again: a fresh decode window
+                    req.span_decode = self.reqtrace.begin(
+                        rid, "decode", replica=self.replica_id, lane=slot,
+                        resumed="swap-abort",
+                    )
                 self.flightrec.record(
                     "swap_abort", rid=rid, direction="out", error=str(e),
                     replica=self.replica_id,
@@ -612,6 +727,18 @@ class Scheduler:
             self._swap_outs += 1
             self._swap_bytes += chain.nbytes
             self.swap_lat.observe(wall)
+            if self.reqtrace.enabled:
+                # predicted next to measured: the decision audit trail
+                self.reqtrace.end(
+                    req.span_swap, ok=True, bytes=chain.nbytes,
+                    wall_s=round(wall, 6),
+                    predicted_s=decision.swap_s,
+                )
+                req.span_swap = 0
+                req.span_parked = self.reqtrace.begin(
+                    rid, "parked", parent=req.span_preempt,
+                    replica=self.replica_id, path="swap",
+                )
             self.flightrec.record(
                 "swap", rid=rid, direction="out", bytes=chain.nbytes,
                 replica=self.replica_id,
@@ -643,11 +770,20 @@ class Scheduler:
             t0 = time.perf_counter()
             if path == "swap":
                 chain = self.host_store.get(rid)
+                self._slot2rid[slot] = rid
                 try:
                     if not self.engine.swap_in_chain(slot, chain):
+                        self._slot2rid.pop(slot, None)
                         break  # no chain free: retry when blocks return
                 except OSError as e:
+                    self._slot2rid.pop(slot, None)
                     self._swap_aborts += 1
+                    if self.reqtrace.enabled:
+                        self.reqtrace.event(
+                            rid, "swap_abort", parent=req.span_preempt,
+                            replica=self.replica_id, direction="in",
+                            error=str(e),
+                        )
                     self.flightrec.record(
                         "swap_abort", rid=rid, direction="in",
                         error=str(e), replica=self.replica_id,
@@ -675,6 +811,18 @@ class Scheduler:
                 self.resident[slot] = req
                 self.positions[slot] = req.length + req.produced
                 self.remaining[slot] = req.max_new_tokens - req.produced
+                if self.reqtrace.enabled:
+                    span_in = self.reqtrace.begin(
+                        rid, "swap_in", parent=req.span_preempt,
+                        replica=self.replica_id, t=t0,
+                    )
+                    self.reqtrace.end(span_in, ok=True,
+                                      bytes=chain.nbytes,
+                                      wall_s=round(wall, 6))
+                    req.span_decode = self.reqtrace.begin(
+                        rid, "decode", replica=self.replica_id,
+                        lane=slot, resumed="swap",
+                    )
             else:  # recompute: generated tokens re-prefill as prompt
                 seq = req.tokens
                 if req.generated:
@@ -682,9 +830,11 @@ class Scheduler:
                         req.tokens,
                         np.asarray(req.generated, np.int32),
                     ])
+                self._slot2rid[slot] = rid
                 if not self.engine.admit(
                     slot, len(seq), req.max_new_tokens - req.produced
                 ):
+                    self._slot2rid.pop(slot, None)
                     break  # pool OOM: retry when blocks return
                 del self.parked[rid]
                 req.tokens = seq
@@ -694,8 +844,23 @@ class Scheduler:
                 self.resident[slot] = req
                 self.positions[slot] = 0
                 self.remaining[slot] = 0  # armed by its final chunk
+                if self.reqtrace.enabled:
+                    req.span_prefill = self.reqtrace.begin(
+                        rid, "prefill", replica=self.replica_id,
+                        slot=slot, resumed="recompute",
+                        chunks=-(-len(seq) // self.engine.chunk),
+                    )
             req.protect_until = self._step_count + self.protect_ticks
             self._restores += 1
+            if self.reqtrace.enabled:
+                self.reqtrace.end(req.span_parked)
+                req.span_parked = 0
+                self.reqtrace.event(
+                    rid, "restore", parent=req.span_preempt,
+                    replica=self.replica_id, slot=slot, path=path,
+                )
+                self.reqtrace.end(req.span_preempt)
+                req.span_preempt = 0
             self.flightrec.record(
                 "restore", rid=rid, slot=slot, path=path,
                 replica=self.replica_id,
@@ -762,6 +927,14 @@ class Scheduler:
                 )
             for j in jobs:
                 req = self.resident[j.slot]
+                if self.reqtrace.enabled:
+                    self.reqtrace.event(
+                        req.rid, "prefill_chunk",
+                        parent=req.span_prefill,
+                        replica=self.replica_id, start=j.start,
+                        program=self.engine.chunk_program_name(*bucket),
+                        cold=cold_bucket or None,
+                    )
                 req.prefill_done += self.engine.chunk
                 if req.prefill_done >= req.length:
                     # prefill complete: arm the decode lane at the
@@ -769,8 +942,16 @@ class Scheduler:
                     # replica, park the request (blocks + slot held) in
                     # ``ready`` for the router's decode handoff
                     self.positions[j.slot] = req.length
+                    if self.reqtrace.enabled:
+                        self.reqtrace.end(req.span_prefill)
+                        req.span_prefill = 0
                     if self.prefill_only:
                         self.ready[req.rid] = j.slot
+                        if self.reqtrace.enabled:
+                            req.span_ready = self.reqtrace.begin(
+                                req.rid, "handoff_wait",
+                                replica=self.replica_id,
+                            )
                     else:
                         # produced > 0 only after a recompute restore:
                         # the re-prefilled stream resumes what is left
@@ -778,6 +959,11 @@ class Scheduler:
                         self.remaining[j.slot] = (
                             req.max_new_tokens - req.produced
                         )
+                        if self.reqtrace.enabled:
+                            req.span_decode = self.reqtrace.begin(
+                                req.rid, "decode",
+                                replica=self.replica_id, lane=j.slot,
+                            )
         active = self.remaining > 0
         self._occupancy_sum += len(self.resident) / self.n_slots
         self._step_count += 1
@@ -846,6 +1032,15 @@ class Scheduler:
                     "retire", rid=req.rid, tokens=req.produced,
                     replica=self.replica_id,
                 )
+                if self.reqtrace.enabled:
+                    self.reqtrace.end(req.span_decode,
+                                      tokens=req.produced)
+                    req.span_decode = 0
+                    self.reqtrace.end(
+                        self.reqtrace.root(req.rid),
+                        outcome="complete", new_tokens=req.produced,
+                        preempts=req.preempts or None,
+                    )
                 self._log_request(req)
             else:
                 self.remaining[slot] -= 1
@@ -988,7 +1183,10 @@ class Scheduler:
         """The decode replica adopted the blocks: free this replica's
         copy (slot + chain) and account the handoff."""
         slot = self.ready.pop(rid)
-        del self.resident[slot]
+        req = self.resident.pop(slot)
+        if self.reqtrace.enabled:
+            self.reqtrace.end(req.span_ready)
+            req.span_ready = 0
         self.engine.release(slot)
         self.remaining[slot] = 0
         self._handoffs += 1
@@ -1013,7 +1211,9 @@ class Scheduler:
         if not free:
             return False
         slot = free[0]
+        self._slot2rid[slot] = req.rid
         if not self.engine.import_chain(slot, export):
+            self._slot2rid.pop(slot, None)
             return False
         req.slot = slot
         req.prefill_done = req.length
@@ -1026,6 +1226,14 @@ class Scheduler:
         self.remaining[slot] = req.max_new_tokens
         self._admitted += 1
         self._adopted += 1
+        if self.reqtrace.enabled:
+            # the adopted decode window opens HERE, on this replica —
+            # the router links the handoff span to it, so the trace
+            # shows the request's timeline switching replicas
+            req.span_decode = self.reqtrace.begin(
+                req.rid, "decode", replica=self.replica_id, lane=slot,
+                adopted=True,
+            )
         return True
 
     # ---- cost cards (telemetry/costmodel.py) ----

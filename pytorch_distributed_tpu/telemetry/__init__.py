@@ -43,6 +43,17 @@ Round 11 adds the attribution-and-forensics layer (ANALYSIS.md
 - ``export`` — a stdlib-HTTP Prometheus-text ``/metrics`` thread
   (``scripts/pdt_top.py`` is the JSONL-tailing terminal twin).
 
+Round 14 adds the causal join layer (ANALYSIS.md "Request-lifecycle
+tracing"):
+
+- ``reqtrace`` — per-request lifecycle traces: rid-keyed span trees
+  (gate decision → queue → prefill → handoff → decode windows →
+  preempt/park/restore → retire) as a versioned ``kind="span"`` JSONL
+  stream, with a completeness validator and a Perfetto/Chrome-trace
+  exporter (``scripts/explain_request.py`` is the forensics CLI);
+- ``schema`` — the JSONL record-kind registry: required keys per kind
+  with a validator, so emitter drift breaks CI instead of the report.
+
 Everything reports through the one JSONL schema of
 ``utils.profiling.MetricsLogger``; ``scripts/telemetry_report.py``
 renders a run's JSONL into the summary table ``bench.py`` consumes.
@@ -77,6 +88,22 @@ from pytorch_distributed_tpu.telemetry.goodput import (
     GoodputLedger,
 )
 from pytorch_distributed_tpu.telemetry.latency import LatencySeries, percentiles
+from pytorch_distributed_tpu.telemetry.reqtrace import (
+    NULL_REQTRACER,
+    SPAN_SCHEMA_VERSION,
+    ReqTracer,
+    build_tree,
+    chrome_trace,
+    save_chrome_trace,
+    span_records,
+    trace_rids,
+    validate_trace,
+)
+from pytorch_distributed_tpu.telemetry.schema import (
+    REQUIRED_KEYS,
+    validate_record,
+    validate_stream,
+)
 from pytorch_distributed_tpu.telemetry.spans import NULL_TRACER, SpanTracer
 
 __all__ = [
@@ -99,6 +126,18 @@ __all__ = [
     "GoodputLedger",
     "LatencySeries",
     "percentiles",
+    "NULL_REQTRACER",
+    "SPAN_SCHEMA_VERSION",
+    "ReqTracer",
+    "build_tree",
+    "chrome_trace",
+    "save_chrome_trace",
+    "span_records",
+    "trace_rids",
+    "validate_trace",
+    "REQUIRED_KEYS",
+    "validate_record",
+    "validate_stream",
     "NULL_TRACER",
     "SpanTracer",
 ]

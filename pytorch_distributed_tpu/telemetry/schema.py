@@ -1,0 +1,105 @@
+"""JSONL schema registry: the one place each record ``kind`` is declared.
+
+Every telemetry producer in this repo writes through
+``utils.profiling.MetricsLogger``, but until round 14 the record shapes
+lived only in the emitters — ``telemetry_report.py`` and ``pdt_top.py``
+discovered drift at render time (a silently absent key degrades a
+section, never fails a build). This module makes the contract explicit:
+``REQUIRED_KEYS`` names the keys every record of a kind must carry,
+``validate_record`` checks one record, ``validate_stream`` a whole run.
+``tests/test_reqtrace.py`` replays every emitter against it, so a
+producer dropping or renaming a key breaks CI instead of the report.
+
+The registry is deliberately a FLOOR, not a straitjacket: emitters may
+add keys freely (reports use ``.get`` for optional ones); only removing
+a required key — the ones consumers index unconditionally — is a
+schema break. Unknown kinds pass by default (``strict=True`` flags
+them), so an experiment can stream new record kinds without registering
+first; promotion to the registry happens when a consumer starts
+depending on them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List
+
+#: required keys per record kind. ``ts`` is stamped by MetricsLogger
+#: itself and therefore not listed. Span records are versioned
+#: separately (``v``; reqtrace.SPAN_SCHEMA_VERSION) and their per-``ev``
+#: shapes are refined by ``_SPAN_EV_KEYS`` below.
+REQUIRED_KEYS: Dict[str, FrozenSet[str]] = {
+    # serving/scheduler.py per-retirement + fleet shed records
+    "request": frozenset(
+        {"rid", "replica_id", "rejected", "prompt_len", "new_tokens"}
+    ),
+    # serving/scheduler.py preempt decision (round 13)
+    "preempt": frozenset(
+        {"rid", "replica_id", "reason", "decision", "decision_reason",
+         "predicted_swap_s", "predicted_recompute_s"}
+    ),
+    # serving/scheduler.py swap-out/in outcomes
+    "swap": frozenset({"rid", "replica_id", "direction", "ok"}),
+    # telemetry/reqtrace.py lifecycle spans (round 14)
+    "span": frozenset({"v", "ev", "trace", "span", "seq", "t"}),
+    # telemetry/goodput.py ledger report
+    "goodput": frozenset({"goodput_frac", "productive_s", "wall_s"}),
+    # telemetry/anomaly.py sentinel hits
+    "anomaly": frozenset({"series", "value", "median", "mad", "zscore"}),
+    # telemetry/costmodel.py per-program cost cards
+    "program_cost": frozenset({"program", "calls"}),
+    # fleet/router.py run rollup
+    "fleet_summary": frozenset(
+        {"replicas", "submitted", "shed", "spilled", "handoffs",
+         "preempts", "restores", "tokens_out"}
+    ),
+    # recipes/serve_lm.py single-scheduler rollup
+    "serving_summary": frozenset({"tokens_out", "completed"}),
+    # compilecache/warmup.py per-program manifest
+    "warmup": frozenset({"program", "seconds", "cache_hit"}),
+}
+
+#: additional required keys per span ``ev`` (see reqtrace module docs)
+_SPAN_EV_KEYS: Dict[str, FrozenSet[str]] = {
+    "begin": frozenset({"name"}),
+    "end": frozenset({"dur_s"}),
+    "event": frozenset({"name"}),
+    "link": frozenset({"dst", "name"}),
+}
+
+
+def validate_record(record: dict, strict: bool = False) -> List[str]:
+    """Errors for one record (empty list == conformant). ``strict``
+    additionally flags kinds the registry does not know."""
+    kind = record.get("kind")
+    if kind is None:
+        return ["record has no 'kind' key"]
+    required = REQUIRED_KEYS.get(kind)
+    if required is None:
+        return [f"unknown kind {kind!r}"] if strict else []
+    errors = [
+        f"kind={kind}: missing required key {k!r}"
+        for k in sorted(required) if k not in record
+    ]
+    if kind == "span":
+        ev = record.get("ev")
+        ev_keys = _SPAN_EV_KEYS.get(ev)
+        if ev_keys is None:
+            errors.append(f"kind=span: unknown ev {ev!r}")
+        else:
+            errors.extend(
+                f"kind=span ev={ev}: missing required key {k!r}"
+                for k in sorted(ev_keys) if k not in record
+            )
+    return errors
+
+
+def validate_stream(records: Iterable[dict],
+                    strict: bool = False) -> List[str]:
+    """Errors across a record stream, each prefixed with its index —
+    the CI conformance gate (and a debugging aid: the index is the JSONL
+    line number for an unrotated stream)."""
+    errors: List[str] = []
+    for i, record in enumerate(records):
+        errors.extend(f"record {i}: {e}"
+                      for e in validate_record(record, strict=strict))
+    return errors

@@ -24,7 +24,7 @@ import json
 import os
 import threading
 import time
-from typing import Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 
 class SpanTracer:
@@ -33,7 +33,12 @@ class SpanTracer:
     ``span(name, **args)`` is a context manager; spans may nest freely
     (the Chrome trace format reconstructs the stack from containment per
     ``tid``). Thread-safe: events append under a lock, ``tid`` is the
-    recording thread's ident.
+    recording thread's ident, and the open-span stack is PER-THREAD
+    (keyed by ``threading.get_ident()``) — concurrent emitters (the
+    background warmup compiler today; ROADMAP item 3's worker threads)
+    each nest within their own stack, so one thread's open span can
+    never become another thread's parent. Each event records its
+    ``depth`` and ``parent`` from that stack.
     """
 
     def __init__(self, enabled: bool = True, mirror_jax: bool = True):
@@ -41,16 +46,31 @@ class SpanTracer:
         self.mirror_jax = bool(mirror_jax)
         self._events: List[dict] = []
         self._lock = threading.Lock()
+        # thread ident -> stack of open span names. Mutated only by the
+        # owning thread, but always under self._lock: the dict itself is
+        # shared, and stack() may read another thread's entry.
+        self._stacks: Dict[int, List[str]] = {}
         self._t0 = time.perf_counter()
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
+
+    def stack(self) -> List[str]:
+        """The CALLING thread's open span names, outermost first."""
+        with self._lock:
+            return list(self._stacks.get(threading.get_ident(), ()))
 
     @contextlib.contextmanager
     def span(self, name: str, **args) -> Iterator[None]:
         if not self.enabled:
             yield
             return
+        tid = threading.get_ident()
+        with self._lock:
+            stack = self._stacks.setdefault(tid, [])
+            depth = len(stack)
+            parent = stack[-1] if stack else None
+            stack.append(name)
         ctx = contextlib.nullcontext()
         if self.mirror_jax:
             try:
@@ -71,11 +91,18 @@ class SpanTracer:
                 "ts": t0,
                 "dur": dur,
                 "pid": os.getpid(),
-                "tid": threading.get_ident(),
+                "tid": tid,
             }
+            if depth:
+                args = dict(args, depth=depth, parent=parent)
             if args:
                 ev["args"] = args
             with self._lock:
+                # this thread's innermost open span is necessarily ours:
+                # spans are context managers, so per-thread exits are LIFO
+                stack.pop()
+                if not stack:
+                    self._stacks.pop(tid, None)
                 self._events.append(ev)
 
     # ---- output ----------------------------------------------------------

@@ -54,6 +54,19 @@ their next tick; ``--n-blocks`` sizes the pool small to provoke it:
     python recipes/serve_lm.py --tiny --requests 24 --slots 4 \
         --n-blocks 12 --preempt --metrics-out pressure.jsonl
 
+Request tracing (round 14; ANALYSIS.md "Request-lifecycle tracing"):
+whenever ``--metrics-out`` is on, every request's lifecycle rides the
+JSONL as a causal span tree (``kind="span"``: gate decision → queue →
+prefill → handoff → decode windows → preempt/park/restore → retire).
+``scripts/explain_request.py`` reconstructs any rid's story and
+``--assert-complete`` gates on a closed acyclic tree; ``--swap-policy
+swap`` forces the preemption path the trace smoke audits
+(predicted-vs-measured swap wall in every preempt span):
+
+    python recipes/serve_lm.py --tiny --replicas 2 --disaggregate \
+        --preempt --swap-policy swap --metrics-out spans.jsonl
+    python scripts/explain_request.py spans.jsonl --find preempted
+
 Cold start (round 8; ANALYSIS.md "Cold start & compile cache"):
 ``--warmup`` compiles every registry program (decode tick + all prefill
 buckets) before admitting traffic, and ``--compile-cache-dir`` points
@@ -114,6 +127,13 @@ def _parse() -> argparse.Namespace:
                         "making the queue wait for a retirement. Fleet: "
                         "the SLO gate's preempt rung turns would-be "
                         "sheds into cheap preemptions")
+    p.add_argument("--swap-policy", choices=("auto", "swap", "recompute"),
+                   default="auto",
+                   help="preemption path: 'auto' takes the measured "
+                        "swap-vs-recompute crossover per request; "
+                        "'swap'/'recompute' force one side (the trace "
+                        "smoke forces swap so the predicted-vs-measured "
+                        "wall lands in every preempt span)")
     p.add_argument("--slo-shed-depth", type=int, default=None,
                    help="fleet shed queue depth (with --preempt the "
                         "gate preempts instead of shedding at this "
@@ -254,11 +274,23 @@ def main() -> None:
         enable_persistent_cache(cache_dir)
     cfg, params, mesh = _model(args)
     prompts = _prompts(args, cfg)
-    from pytorch_distributed_tpu.telemetry import NULL_TRACER, SpanTracer
+    from pytorch_distributed_tpu.telemetry import (
+        NULL_REQTRACER,
+        NULL_TRACER,
+        ReqTracer,
+        SpanTracer,
+    )
     from pytorch_distributed_tpu.utils.profiling import MetricsLogger
 
     tracer = SpanTracer() if args.trace_dir else NULL_TRACER
     mlog = MetricsLogger(args.metrics_out)
+    # request-lifecycle tracing (round 14): whenever the JSONL stream is
+    # on, every request's causal span tree rides along as kind="span"
+    # records — scripts/explain_request.py reconstructs any rid from it
+    reqtrace = (
+        ReqTracer(mlog) if args.metrics_out and not args.dense
+        else NULL_REQTRACER
+    )
     t0 = time.perf_counter()
     fleet_mode = args.replicas > 1 or args.disaggregate or args.trace
     if args.dense and (args.cost_cards or args.metrics_port is not None):
@@ -290,14 +322,17 @@ def main() -> None:
             slo_kw["spill_queue_depth"] = max(1, args.slo_shed_depth // 4)
         slo = SLOConfig(**slo_kw)
         pressure_kw = (
-            dict(offload=True, preempt_on_oom=True) if args.preempt else {}
+            dict(offload=True, preempt_on_oom=True,
+                 swap_policy=args.swap_policy)
+            if args.preempt else {}
         )
         router = FleetRouter(
             cfg, params, n_replicas=max(args.replicas, 2)
             if args.disaggregate else args.replicas,
             disaggregate=args.disaggregate,
             n_prefill=args.prefill_replicas, slo=slo, seed=args.seed,
-            metrics_log=mlog, tracer=tracer, n_slots=args.slots,
+            metrics_log=mlog, tracer=tracer, reqtrace=reqtrace,
+            n_slots=args.slots,
             block_len=args.block_len, prefill_chunk=args.prefill_chunk,
             admit_per_step=args.admit_per_step, n_blocks=args.n_blocks,
             gather_impl=args.gather_impl, kv_dtype=args.kv_dtype,
@@ -377,8 +412,10 @@ def main() -> None:
             prefill_chunk=args.prefill_chunk, n_blocks=args.n_blocks,
             admit_per_step=args.admit_per_step, seed=args.seed,
             mesh=mesh, tracer=tracer, metrics_log=mlog,
+            reqtrace=reqtrace,
             gather_impl=args.gather_impl, kv_dtype=args.kv_dtype,
             offload=args.preempt, preempt_on_oom=args.preempt,
+            swap_policy=args.swap_policy,
         )
         if args.warmup:
             # everything foreground + executed inert: the serve loop below

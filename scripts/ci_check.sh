@@ -9,7 +9,8 @@
 #                             --resilience-smoke|--serving-smoke|
 #                             --telemetry-smoke|--warmup-smoke|--reshard-smoke|
 #                             --fleet-smoke|--obs-smoke|--kernel-smoke|
-#                             --pressure-smoke|--bench-regression]
+#                             --pressure-smoke|--trace-smoke|
+#                             --bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -69,6 +70,16 @@
 # replacing the reject), then telemetry_report.py must render the
 # pressure section (--require pressure: preempt rate, swap p95,
 # decision crossover) from the JSONL alone (~30 s).
+#
+# --trace-smoke: lint, then the round-14 request-lifecycle tracing
+# cycle: one disaggregated 2-replica serve (prefill/decode split, small
+# decode pool, --preempt --swap-policy swap so the handoff pump's
+# pressure rung forces at least one swap-path preemption) over a seeded
+# bursty trace, then explain_request.py --assert-complete must
+# reconstruct a single closed acyclic span tree for BOTH a preempted
+# AND a handed-off rid (found by predicate, not hard-coded), a
+# Perfetto-loadable Chrome trace must parse, and telemetry_report.py
+# must render the request-trace section (--require spans) (~20 s).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -200,6 +211,35 @@ print(f"pressure: {fleet['preempts']} preempts, {fleet['restores']} "
 PY
     JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
         "$smoke/pressure.jsonl" --json --require pressure
+    exit 0
+fi
+
+if [[ "${1:-}" == "--trace-smoke" ]]; then
+    echo "== trace smoke (disagg serve + forced preempt -> causal traces) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py \
+        --gen-trace "$smoke/trace.jsonl" --trace-duration 30 \
+        --trace-base-rate 0.7 --trace-prompt-max 88
+    JAX_PLATFORMS=cpu python recipes/serve_lm.py --tiny --replicas 2 \
+        --disaggregate --slots 4 --n-blocks 13 --max-new 8 \
+        --preempt --swap-policy swap --trace "$smoke/trace.jsonl" \
+        --metrics-out "$smoke/spans.jsonl"
+    JAX_PLATFORMS=cpu python scripts/explain_request.py \
+        "$smoke/spans.jsonl" --find handed-off --assert-complete
+    JAX_PLATFORMS=cpu python scripts/explain_request.py \
+        "$smoke/spans.jsonl" --find preempted --assert-complete \
+        --perfetto "$smoke/requests.trace.json"
+    python - "$smoke/requests.trace.json" <<'PY'
+import json, sys
+trace = json.load(open(sys.argv[1]))
+events = trace["traceEvents"]
+assert any(e.get("ph") == "X" for e in events), "no complete spans"
+assert any(e.get("ph") == "s" for e in events), "no handoff flow arrows"
+print(f"perfetto trace: {len(events)} events OK")
+PY
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/spans.jsonl" --json --require spans
     exit 0
 fi
 

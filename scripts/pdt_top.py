@@ -13,7 +13,12 @@ fleet's shared stream) and re-render an aggregate view every
   role from the newest ``fleet_summary``;
 - **anomalies**: per-series counts plus the most recent excursion;
 - **cost**: the top measured programs by attributed wall (once
-  ``kind="program_cost"`` cards exist).
+  ``kind="program_cost"`` cards exist);
+- **inflight** (round 14): requests currently in flight, sourced from
+  the lifecycle span stream — roots begun but not yet ended;
+- **pressure** (round 14): preempt count/rate and decision mix, parked
+  chains from the newest ``fleet_summary``, swap bytes moved and
+  aborts, from ``kind="preempt"``/``kind="swap"`` records.
 
 Only new bytes are read per refresh (the files are followed, not
 re-parsed), so tailing a long run is O(new events). ``--once`` renders
@@ -90,6 +95,16 @@ class View:
         self.cost: Dict[str, dict] = {}
         self.sheds = 0
         self.tokens = 0
+        # pressure tier counters (kind="preempt"/"swap" records)
+        self.preempts = 0
+        self.preempt_decisions: Dict[str, int] = {}
+        self.swap_bytes = 0
+        self.swap_aborts = 0
+        # request-lifecycle spans (kind="span"): open span set and open
+        # ROOTS — the live in-flight-requests gauge
+        self.open_spans: set = set()
+        self.open_roots: set = set()
+        self.span_records = 0
 
     def feed(self, records: List[dict]) -> None:
         for r in records:
@@ -110,6 +125,27 @@ class View:
                 self.last_anomaly = r
             elif kind == "program_cost":
                 self.cost[r["program"]] = r
+            elif kind == "preempt":
+                self.preempts += 1
+                d = r.get("decision", "?")
+                self.preempt_decisions[d] = (
+                    self.preempt_decisions.get(d, 0) + 1
+                )
+            elif kind == "swap":
+                if r.get("ok"):
+                    self.swap_bytes += r.get("bytes", 0)
+                else:
+                    self.swap_aborts += 1
+            elif kind == "span":
+                self.span_records += 1
+                key = (r.get("trace"), r.get("span"))
+                if r.get("ev") == "begin":
+                    self.open_spans.add(key)
+                    if not r.get("parent"):
+                        self.open_roots.add(key)
+                elif r.get("ev") == "end":
+                    self.open_spans.discard(key)
+                    self.open_roots.discard(key)
 
     # ---- rendering -------------------------------------------------------
 
@@ -158,6 +194,30 @@ class View:
                 line += (f"  tok {gaps['p50'] * 1e3:.1f}/"
                          f"{gaps['p95'] * 1e3:.1f} ms")
             out.append(line)
+        if self.span_records:
+            # in-flight = roots begun but not yet ended in the stream —
+            # the live gauge the lifecycle traces give for free
+            out.append(
+                f"inflight {len(self.open_roots)} requests "
+                f"({len(self.open_spans)} open spans, "
+                f"{self.span_records} span records)"
+            )
+        if self.preempts or self.swap_bytes:
+            served = len(self.requests) + self.sheds
+            rate = self.preempts / served if served else 0.0
+            fs = self.last.get("fleet_summary") or {}
+            parked = fs.get("parked")
+            out.append(
+                f"pressure {self.preempts} preempts ({rate:.1%})"
+                + (f"  parked={parked}" if parked is not None else "")
+                + f"  swap {self.swap_bytes / 2**20:.2f} MiB"
+                + (f"  aborts={self.swap_aborts}"
+                   if self.swap_aborts else "")
+                + ("  [" + ", ".join(
+                    f"{k}={v}" for k, v in
+                    sorted(self.preempt_decisions.items())) + "]"
+                   if self.preempt_decisions else "")
+            )
         fs = self.last.get("fleet_summary")
         if fs:
             reps = fs.get("replicas", 0)

@@ -30,7 +30,11 @@ produces, from the JSONL alone:
 - the **pressure section** (round 13; KV offload + preemption) —
   preempt rate, per-direction swap p50/p95 and bytes moved, swap-vs-
   recompute decision counts and the predicted-cost crossover histogram,
-  from ``kind="preempt"``/``kind="swap"`` records.
+  from ``kind="preempt"``/``kind="swap"`` records;
+- the **request-trace section** (round 14; ``telemetry/reqtrace.py``) —
+  lifecycle trace counts, completeness (every span closed, parents
+  acyclic), open spans, and phase totals from ``kind="span"`` records
+  (``scripts/explain_request.py`` reconstructs any single rid).
 
 Usage:
     python scripts/telemetry_report.py RUN.jsonl [SERVE.jsonl ...] [--json]
@@ -416,6 +420,49 @@ def pressure_section(records: List[dict], out: dict) -> List[str]:
     return lines
 
 
+def span_section(records: List[dict], out: dict) -> List[str]:
+    """Request-lifecycle traces (round 14; ``kind="span"`` from
+    ``telemetry.reqtrace``): trace count, completeness, open (in-flight
+    or abandoned) spans, and lifecycle phase totals —
+    ``scripts/explain_request.py`` is the per-rid deep dive."""
+    from pytorch_distributed_tpu.telemetry.reqtrace import (
+        span_records,
+        trace_rids,
+        validate_trace,
+    )
+
+    spans = span_records(records)
+    if not spans:
+        return []
+    rids = trace_rids(records)
+    complete = sum(1 for r in rids if not validate_trace(records, r))
+    begins = {(r["trace"], r["span"]) for r in spans
+              if r.get("ev") == "begin"}
+    ends = {(r["trace"], r["span"]) for r in spans if r.get("ev") == "end"}
+    open_spans = len(begins - ends)
+    by_phase: dict = {}
+    for r in spans:
+        if r.get("ev") == "end":
+            continue
+        if r.get("ev") == "begin":
+            by_phase[r.get("name", "?")] = (
+                by_phase.get(r.get("name", "?"), 0) + 1
+            )
+    lines = ["== request traces =="]
+    lines.append(
+        f"  {len(rids)} traces ({complete} complete, "
+        f"{len(rids) - complete} incomplete), {len(spans)} span records, "
+        f"{open_spans} open spans"
+    )
+    top = sorted(by_phase.items(), key=lambda kv: (-kv[1], kv[0]))[:8]
+    lines.append("  phases: " + ", ".join(f"{n}={c}" for n, c in top))
+    out["span_traces"] = len(rids)
+    out["span_complete_traces"] = complete
+    out["span_open"] = open_spans
+    out["span_records"] = len(spans)
+    return lines
+
+
 def anomaly_section(records: List[dict], out: dict) -> List[str]:
     """Sentinel hits (``kind="anomaly"``): per-series counts and the
     latest excursions with their z-scores and baselines."""
@@ -451,10 +498,10 @@ def main(argv=None) -> int:
     p.add_argument("--require", default=None,
                    help="comma list of sections that MUST be present "
                         "(goodput, serving, warmup, fleet, pressure, "
-                        "cost, anomaly) — exit non-zero otherwise; the "
-                        "ci_check.sh --telemetry-smoke, --warmup-smoke, "
-                        "--fleet-smoke, --obs-smoke and "
-                        "--pressure-smoke gates")
+                        "spans, cost, anomaly) — exit non-zero "
+                        "otherwise; the ci_check.sh --telemetry-smoke, "
+                        "--warmup-smoke, --fleet-smoke, --obs-smoke, "
+                        "--pressure-smoke and --trace-smoke gates")
     args = p.parse_args(argv)
 
     records = load_records(args.paths)
@@ -466,6 +513,7 @@ def main(argv=None) -> int:
     lines += serving_section(records, out)
     lines += fleet_section(records, out)
     lines += pressure_section(records, out)
+    lines += span_section(records, out)
     lines += cost_section(records, out)
     lines += anomaly_section(records, out)
     if not lines:
@@ -478,6 +526,7 @@ def main(argv=None) -> int:
         "warmup": "warmup_programs" in out,
         "fleet": "fleet_replicas" in out,
         "pressure": out.get("pressure_preempts", 0) > 0,
+        "spans": out.get("span_traces", 0) > 0,
         "cost": out.get("cost_programs", 0) > 0,
         "anomaly": out.get("anomalies", 0) > 0,
     }
