@@ -80,10 +80,12 @@ class FleetRouter:
                  handoffs_per_tick: Optional[int] = None,
                  slo: Optional[SLOConfig] = None, devices=None,
                  seed: int = 0, metrics_log=None, tracer=None,
-                 flightrec=None, reqtrace=None, **scheduler_kwargs):
+                 flightrec=None, reqtrace=None, ledger=None,
+                 **scheduler_kwargs):
         import jax
 
         from pytorch_distributed_tpu.telemetry import (
+            NULL_LEDGER,
             NULL_RECORDER,
             NULL_REQTRACER,
         )
@@ -111,6 +113,12 @@ class FleetRouter:
         # crosses the admission gate, the prefill replica, the handoff,
         # and the decode replica
         self.reqtrace = reqtrace if reqtrace is not None else NULL_REQTRACER
+        # host–device overlap ledger (round 15): ONE shared
+        # DispatchLedger across the fleet, so every replica's launches
+        # land on one wall-clock axis and a gap on replica B can be
+        # attributed to replica A's tick — the one-loop serialization
+        # ROADMAP item 3's async refactor must remove
+        self.ledger = ledger if ledger is not None else NULL_LEDGER
         self.replicas: List[Scheduler] = []
         self.roles: List[str] = []
         for i in range(n_replicas):
@@ -137,7 +145,7 @@ class FleetRouter:
                 prefill_only=(role == "prefill"), device=dev,
                 handoff=disaggregate, metrics_log=metrics_log,
                 tracer=tracer, flightrec=self.flightrec,
-                reqtrace=self.reqtrace, **kw,
+                reqtrace=self.reqtrace, ledger=self.ledger, **kw,
             ))
             self.roles.append(role)
         self.disaggregated = disaggregate
@@ -186,9 +194,10 @@ class FleetRouter:
         preferred = (
             self._affinity.get(session) if session is not None else None
         )
-        decision = self.gate.route(
-            self._group_metrics(self.entry_group), preferred
-        )
+        with self.ledger.host("admission/gate"):
+            decision = self.gate.route(
+                self._group_metrics(self.entry_group), preferred
+            )
         if self.reqtrace.enabled:
             # the gate decision opens the request's root span — the
             # first causal fact of its lifecycle (a shed closes it
@@ -328,7 +337,8 @@ class FleetRouter:
         for i in self.entry_group:
             out.extend(self.replicas[i].step())
         if self.decode_group:
-            self._pump_handoffs()
+            with self.ledger.host("handoff-pump"):
+                self._pump_handoffs()
         for rid, tok in out:
             self.results.setdefault(rid, []).append(tok)
         self._tick += 1
@@ -457,4 +467,5 @@ class FleetRouter:
         """One ``kind="fleet_summary"`` JSONL record — the fleet half of
         what ``scripts/telemetry_report.py`` renders."""
         if self.metrics_log is not None:
-            self.metrics_log.log(kind="fleet_summary", **self.metrics())
+            with self.ledger.host("jsonl-emit"):
+                self.metrics_log.log(kind="fleet_summary", **self.metrics())

@@ -50,6 +50,7 @@ from pytorch_distributed_tpu.serving.kv_pool import (
     init_paged_cache,
     paged_cache_specs,
 )
+from pytorch_distributed_tpu.telemetry.overlap import NULL_LEDGER
 
 
 def _pow2_bucket(n: int) -> int:
@@ -186,6 +187,12 @@ class PagedEngine:
 
         self._chunk_fns: Dict[Tuple[int, int], callable] = {}
         self._decode_fn = None
+        # host–device overlap ledger (round 15; telemetry/overlap.py):
+        # every compiled launch below reports its dispatch wall through
+        # it. NULL_LEDGER by default; the scheduler arms it and stamps
+        # the replica id so fleet timelines attribute per replica.
+        self.ledger = NULL_LEDGER
+        self.ledger_replica = 0
         # prefill→decode handoff programs (fleet disaggregation), one
         # per pow2 chain-length bucket. Gated by ``handoff=`` so engines
         # that never hand off predict no kv_export/kv_import programs
@@ -717,10 +724,13 @@ class PagedEngine:
         n_pad = self._chain_bucket(len(chain))
         idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
         idx[:len(chain)] = chain
-        blocks, row = self._export_fn(n_pad)(
-            self.cache, self.logits, jnp.asarray(idx),
-            jnp.asarray(slot, jnp.int32),
-        )
+        with self.ledger.launch(self.ledger_replica,
+                                self.export_program_name(n_pad)) as lt:
+            blocks, row = self._export_fn(n_pad)(
+                self.cache, self.logits, jnp.asarray(idx),
+                jnp.asarray(slot, jnp.int32),
+            )
+            lt.handle = row  # pure-read output: safe to fence lagged
         return KVExport(
             blocks=blocks,
             logits_row=row,
@@ -756,10 +766,12 @@ class PagedEngine:
             export.blocks, self.cache,
         )
         row = jax.device_put(export.logits_row, self.logits.sharding)
-        self.cache, self.logits = self._import_fn(n_pad)(
-            self.cache, self.logits, blocks, jnp.asarray(idx),
-            jnp.asarray(slot, jnp.int32), row,
-        )
+        with self.ledger.launch(self.ledger_replica,
+                                self.import_program_name(n_pad)):
+            self.cache, self.logits = self._import_fn(n_pad)(
+                self.cache, self.logits, blocks, jnp.asarray(idx),
+                jnp.asarray(slot, jnp.int32), row,
+            )
         self.tables[slot] = TRASH_BLOCK
         self.tables[slot, :export.n_blocks] = chain
         return True
@@ -833,10 +845,13 @@ class PagedEngine:
         n_pad = self._chain_bucket(len(chain))
         idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
         idx[:len(chain)] = chain
-        blocks, row = self._swap_out_fn(n_pad)(
-            self.cache, self.logits, jnp.asarray(idx),
-            jnp.asarray(slot, jnp.int32),
-        )
+        with self.ledger.launch(self.ledger_replica,
+                                self.swap_out_program_name(n_pad)) as lt:
+            blocks, row = self._swap_out_fn(n_pad)(
+                self.cache, self.logits, jnp.asarray(idx),
+                jnp.asarray(slot, jnp.int32),
+            )
+            lt.handle = row  # pure-read output: safe to fence lagged
         for leaf in jax.tree.leaves(blocks) + [row]:
             try:
                 leaf.copy_to_host_async()  # overlap d2h with serving
@@ -924,10 +939,12 @@ class PagedEngine:
 
             blocks = jax.tree.map(_padded, chain.blocks, self.cache)
             row = jax.device_put(chain.logits_row, self.logits.sharding)
-            self.cache, self.logits = self._swap_in_fn(n_pad)(
-                self.cache, self.logits, blocks, jnp.asarray(idx),
-                jnp.asarray(slot, jnp.int32), row,
-            )
+            with self.ledger.launch(self.ledger_replica,
+                                    self.swap_in_program_name(n_pad)):
+                self.cache, self.logits = self._swap_in_fn(n_pad)(
+                    self.cache, self.logits, blocks, jnp.asarray(idx),
+                    jnp.asarray(slot, jnp.int32), row,
+                )
         except BaseException:
             self.allocator.clear_state(slot)
             self.allocator.free(slot)
@@ -971,11 +988,17 @@ class PagedEngine:
             is_last[i] = j.is_last
             last_idx[i] = j.last_idx
         fn = self._chunk_fn(k_pad, wp)
-        self.cache, self.logits = fn(
-            self.params, self.cache, self.logits, jnp.asarray(tokens),
-            jnp.asarray(starts), jnp.asarray(tables), jnp.asarray(slots),
-            jnp.asarray(is_last), jnp.asarray(last_idx),
-        )
+        # no fence handle: both outputs are donated into later programs,
+        # so completion rides the t1 lower bound tightened by the next
+        # sync launch on this replica stream (the decode tick)
+        with self.ledger.launch(self.ledger_replica,
+                                self.chunk_program_name(k_pad, wp)):
+            self.cache, self.logits = fn(
+                self.params, self.cache, self.logits, jnp.asarray(tokens),
+                jnp.asarray(starts), jnp.asarray(tables),
+                jnp.asarray(slots), jnp.asarray(is_last),
+                jnp.asarray(last_idx),
+            )
         self._hot_chunks.add((k_pad, wp))
 
     def decode(self, positions: np.ndarray, active: np.ndarray, rng):
@@ -989,10 +1012,16 @@ class PagedEngine:
             # keys are computed arrays; pin them next to the replica's
             # committed working set so the program has one placement
             rng = jax.device_put(rng, self.device)
-        self.cache, self.logits, positions, tokens = fn(
-            self.params, self.cache, self.logits,
-            jnp.asarray(positions, jnp.int32), jnp.asarray(active),
-            jnp.asarray(masked), rng,
-        )
+        # sync launch: the token fetch inside the window materializes
+        # the program's result, so t1 IS device completion — the exact
+        # anchor the chunk launches' lower bounds tighten against
+        with self.ledger.launch(self.ledger_replica, self.DECODE_PROGRAM,
+                                sync=True):
+            self.cache, self.logits, positions, tokens = fn(
+                self.params, self.cache, self.logits,
+                jnp.asarray(positions, jnp.int32), jnp.asarray(active),
+                jnp.asarray(masked), rng,
+            )
+            tokens = np.asarray(tokens)
         self._hot_decode = True
-        return np.asarray(tokens), np.array(positions)
+        return tokens, np.array(positions)

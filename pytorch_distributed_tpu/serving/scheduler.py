@@ -85,6 +85,7 @@ import numpy as np
 
 from pytorch_distributed_tpu.compilecache.aot import attribute_compile
 from pytorch_distributed_tpu.telemetry import (
+    NULL_LEDGER,
     NULL_RECORDER,
     NULL_REQTRACER,
     NULL_TRACER,
@@ -186,7 +187,7 @@ class Scheduler:
                  swap_policy: str = "auto", protect_ticks: int = 2,
                  host_store=None,
                  host_store_max_bytes: Optional[int] = None,
-                 reqtrace=None):
+                 reqtrace=None, ledger=None):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
         from pytorch_distributed_tpu.serving.kv_pool import HostBlockStore
 
@@ -312,6 +313,13 @@ class Scheduler:
         self._slot2rid: Dict[int, int] = {}
         if self.reqtrace.enabled:
             self.engine.set_kv_trace(self._kv_transition)
+        # host–device overlap ledger (round 15; telemetry/overlap.py):
+        # the engine reports every compiled launch through it, and the
+        # host marks below (admission, JSONL emit, swap decision) are
+        # the attribution targets its bubble classifier resolves to
+        self.ledger = ledger if ledger is not None else NULL_LEDGER
+        self.engine.ledger = self.ledger
+        self.engine.ledger_replica = replica_id
         # anomaly sentinel over tick time / TTFT / queue depth; a recent
         # hit surfaces as metrics()["anomaly_recent"], which the fleet
         # SLOGate reads as a hot signal (spill around this replica)
@@ -617,7 +625,8 @@ class Scheduler:
         req = self.resident[slot]
         if req.prefill_done < req.length:
             raise ValueError(f"rid {rid} is mid-prefill: not preemptible")
-        decision = self._swap_decision(req, slot)
+        with self.ledger.host("swap-decision", self.replica_id):
+            decision = self._swap_decision(req, slot)
         if decision is None:
             return None
         if self.reqtrace.enabled:
@@ -899,7 +908,8 @@ class Scheduler:
             # ahead of the queue, before its next decode tick
             self._finalize_swaps()
             self._restore_parked()
-        with self.tracer.span("admission", queued=len(self.queue)):
+        with self.tracer.span("admission", queued=len(self.queue)), \
+                self.ledger.host("admission/gate", self.replica_id):
             self._admit()
         jobs = self._chunk_jobs()
         if jobs:
@@ -1071,6 +1081,10 @@ class Scheduler:
         per-request latencies ``telemetry_report.py`` aggregates."""
         if self.metrics_log is None:
             return
+        with self.ledger.host("jsonl-emit", self.replica_id):
+            self._log_request_record(req)
+
+    def _log_request_record(self, req: Request) -> None:
         self.metrics_log.log(
             kind="request",
             rid=req.rid,

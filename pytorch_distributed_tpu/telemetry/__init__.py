@@ -54,6 +54,18 @@ tracing"):
 - ``schema`` — the JSONL record-kind registry: required keys per kind
   with a validator, so emitter drift breaks CI instead of the report.
 
+Round 15 adds the host–device overlap layer (ANALYSIS.md "Host–device
+overlap"):
+
+- ``overlap`` — a dispatch ledger wrapping every compiled call site
+  (engine chunk/decode/export/import/swap, trainer train/eval steps):
+  host dispatch walls, lagged device-completion fences (never a sync on
+  the hot path), a per-replica device timeline, and every inter-launch
+  gap classified as a bubble attributed to its host cause by joining
+  the span stream's logical clock (``kind="overlap"`` JSONL;
+  ``scripts/bench_serving.py --wall-clock`` is the fleet bench ROADMAP
+  item 3's async refactor gates against).
+
 Everything reports through the one JSONL schema of
 ``utils.profiling.MetricsLogger``; ``scripts/telemetry_report.py``
 renders a run's JSONL into the summary table ``bench.py`` consumes.
@@ -88,6 +100,15 @@ from pytorch_distributed_tpu.telemetry.goodput import (
     GoodputLedger,
 )
 from pytorch_distributed_tpu.telemetry.latency import LatencySeries, percentiles
+from pytorch_distributed_tpu.telemetry.overlap import (
+    NULL_LEDGER,
+    DispatchLedger,
+    busy_summary,
+    busy_within,
+    cause_histogram,
+    classify_bubbles,
+    device_timeline,
+)
 from pytorch_distributed_tpu.telemetry.reqtrace import (
     NULL_REQTRACER,
     SPAN_SCHEMA_VERSION,
@@ -126,6 +147,13 @@ __all__ = [
     "GoodputLedger",
     "LatencySeries",
     "percentiles",
+    "NULL_LEDGER",
+    "DispatchLedger",
+    "busy_summary",
+    "busy_within",
+    "cause_histogram",
+    "classify_bubbles",
+    "device_timeline",
     "NULL_REQTRACER",
     "SPAN_SCHEMA_VERSION",
     "ReqTracer",

@@ -41,6 +41,9 @@ REQUIRED_KEYS: Dict[str, FrozenSet[str]] = {
     "swap": frozenset({"rid", "replica_id", "direction", "ok"}),
     # telemetry/reqtrace.py lifecycle spans (round 14)
     "span": frozenset({"v", "ev", "trace", "span", "seq", "t"}),
+    # telemetry/overlap.py dispatch ledger (round 15); per-``ev`` shapes
+    # refined by ``_OVERLAP_EV_KEYS`` below
+    "overlap": frozenset({"ev", "replica"}),
     # telemetry/goodput.py ledger report
     "goodput": frozenset({"goodput_frac", "productive_s", "wall_s"}),
     # telemetry/anomaly.py sentinel hits
@@ -66,6 +69,14 @@ _SPAN_EV_KEYS: Dict[str, FrozenSet[str]] = {
     "link": frozenset({"dst", "name"}),
 }
 
+#: additional required keys per overlap ``ev`` (see overlap module docs)
+_OVERLAP_EV_KEYS: Dict[str, FrozenSet[str]] = {
+    "launch": frozenset({"program", "t0", "t1", "seq0", "seq1"}),
+    "host": frozenset({"name", "t0", "t1", "seq0", "seq1"}),
+    "bubble": frozenset({"cause", "gap_s", "t0", "t1"}),
+    "summary": frozenset({"launches", "busy_s", "span_s", "busy_frac"}),
+}
+
 
 def validate_record(record: dict, strict: bool = False) -> List[str]:
     """Errors for one record (empty list == conformant). ``strict``
@@ -80,14 +91,17 @@ def validate_record(record: dict, strict: bool = False) -> List[str]:
         f"kind={kind}: missing required key {k!r}"
         for k in sorted(required) if k not in record
     ]
-    if kind == "span":
+    for refined, table in (("span", _SPAN_EV_KEYS),
+                           ("overlap", _OVERLAP_EV_KEYS)):
+        if kind != refined:
+            continue
         ev = record.get("ev")
-        ev_keys = _SPAN_EV_KEYS.get(ev)
+        ev_keys = table.get(ev)
         if ev_keys is None:
-            errors.append(f"kind=span: unknown ev {ev!r}")
+            errors.append(f"kind={kind}: unknown ev {ev!r}")
         else:
             errors.extend(
-                f"kind=span ev={ev}: missing required key {k!r}"
+                f"kind={kind} ev={ev}: missing required key {k!r}"
                 for k in sorted(ev_keys) if k not in record
             )
     return errors

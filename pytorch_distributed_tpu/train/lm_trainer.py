@@ -171,6 +171,10 @@ class LMTrainerConfig:
     flightrec: bool = True
     cost_cards: bool = False
     metrics_port: Optional[int] = None
+    # Host–device overlap profiling — see TrainerConfig.overlap: the
+    # dispatch ledger (kind="overlap" JSONL) over train/eval launches,
+    # lagged-fenced on the step's metrics outputs.
+    overlap: bool = False
 
 
 class LMTrainer(SuspendableTrainer):
@@ -466,8 +470,11 @@ class LMTrainer(SuspendableTrainer):
             # win; later recompiles are a guarded hazard, not steady state
             first = self._dispatched == 0
             with self.tracer.span("step_dispatch", step=step), \
-                    attribute_compile(self.goodput if first else None):
+                    attribute_compile(self.goodput if first else None), \
+                    self.ledger.launch(0, "lm_train_step") as launch:
                 self.state, metrics = self.train_step(self.state, batch)
+                # fresh (non-donated) outputs: the lagged fence target
+                launch.handle = metrics
             self._dispatched += 1
             self._post_step(metrics)
             steps_done += 1
@@ -536,12 +543,15 @@ class LMTrainer(SuspendableTrainer):
                     )
                     for k, v in host_batch.items()
                 }
-            acc = self.eval_step(
-                self.state,
-                shard_lm_batch(self.mesh, host_batch,
-                               layout=self.model_config.ring_layout),
-                acc
-            )
+            # no fence handle: the accumulator is donated into the next
+            # eval call, so completion rides the t1 lower bound
+            with self.ledger.launch(0, "lm_eval_step"):
+                acc = self.eval_step(
+                    self.state,
+                    shard_lm_batch(self.mesh, host_batch,
+                                   layout=self.model_config.ring_layout),
+                    acc
+                )
         acc = jax.device_get(acc)
         tokens = float(acc["tokens"])
         if tokens == 0.0:

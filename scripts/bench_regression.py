@@ -43,6 +43,18 @@ REPO_DEFAULT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BAND = 0.10
 #: key-pattern bands for known-noisy measurements (first match wins)
 BAND_OVERRIDES: Tuple[Tuple[str, float], ...] = (
+    # direction-aware fractions (round 15): bounded in [0, 1], so the
+    # wall-clock catch-all's 150% band below would make them
+    # unflaggable — a halved device-busy fraction IS the regression the
+    # async-refactor A/B exists to catch. Ordered first: first match
+    # wins.
+    (r"device_busy_frac", 0.5),
+    (r"gap_accounted_frac", 0.10),
+    # the wall-clock fleet bench (round 15) measures MACHINE wall on a
+    # shared box — the same weather class as the disk keys; its CPU
+    # magnitudes are additionally backend-marked as not-a-claim
+    # (PERF_NOTES §11)
+    (r"^serving_wallclock_", 1.5),
     # shared-disk weather moves raw bandwidth 2x day to day (PERF_NOTES
     # §8); anything disk-bound inherits that swing
     (r"^ckpt_", 1.5),
@@ -75,10 +87,23 @@ def band_for(key: str, overrides: Dict[str, float]) -> float:
     return DEFAULT_BAND
 
 
+#: direction overrides checked BEFORE the skip list: fractions are
+#: normally configuration-like and skipped, but device-busy fraction is
+#: a direction-aware measurement (higher = less idle device) — the
+#: round-15 overlap keys the async-refactor A/B will move
+DIRECTION_OVERRIDES: Tuple[Tuple[str, str], ...] = (
+    (r"device_busy_frac", "up"),
+    (r"gap_accounted_frac", "up"),
+)
+
+
 def direction(key: str) -> Optional[str]:
     """'up' = higher is better, 'down' = lower is better, None = skip.
     Throughput patterns win over the time-suffix patterns (a *_tok_s key
     is a rate even though it ends in _s)."""
+    for pattern, sense in DIRECTION_OVERRIDES:
+        if re.search(pattern, key):
+            return sense
     for pattern in SKIP_PATTERNS:
         if re.search(pattern, key):
             return None

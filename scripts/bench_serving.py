@@ -18,10 +18,21 @@ Usage: python scripts/bench_serving.py [--slots 32]
            --trace-max-new-median 12 --trace-prefill-heavy]
        python scripts/bench_serving.py --fleet [--trace T.jsonl]   # 1r vs 2r
        python scripts/bench_serving.py --disagg [--trace T.jsonl]  # colo vs PD
+       python scripts/bench_serving.py --wall-clock [--trace T.jsonl
+           --wc-replicas 2 --wc-slots 4 --wc-out overlap.jsonl]  # round 15
        python scripts/bench_serving.py --gather-ab [--tiny --ab-slots 8
            --ab-ticks 32 --ab-prompt-len 64]  # pallas-vs-dense + int8 capacity
        python scripts/bench_serving.py --pressure [--pressure-sessions 100000
            --pressure-blocks 13 --pressure-duration 90]  # preempt vs shed-only
+
+Round 15 (overlap profiler): ``--wall-clock`` is the ROADMAP-item-3
+fleet bench — ONE trace served saturated (no nominal tick) by 1 replica
+vs N with the dispatch ledger armed, reporting aggregate tok/s both
+sides, per-replica device-busy fraction, and the bubble-cause histogram
+that must account for >=90% of the measured 1→N efficiency gap
+(``serving_wallclock_*``; backend-marked, CPU magnitudes not
+regression-gated). ``--wc-out`` keeps the run's span+overlap JSONL for
+``telemetry_report.py --require overlap`` / ``explain_request.py``.
 
 Round 13 (pressure tier): ``--pressure`` replays one over-committed
 bursty trace (default 100k session ids on a pool holding ~3 chains per
@@ -794,6 +805,142 @@ def measure_pressure(trace=None, slots: int = 4, n_blocks: int = 13,
     }
 
 
+# ---------------------------------------------------------------------------
+# wall-clock fleet bench (round 15): the overlap profiler's headline —
+# the measurement contract ROADMAP item 3's async host refactor gates on
+# ---------------------------------------------------------------------------
+
+
+def _wallclock_side(cfg, params, trace, n_replicas, slots, out_path=None):
+    """One saturated wall-clock run: every arrival submitted up front
+    (tokenized under a ledger mark), then the fleet loop cranked
+    back-to-back until idle — no nominal tick. Unlike the step-domain
+    benches this measures MACHINE wall, which is exactly the point: the
+    one-loop router serializes replica host work, and the ledger's
+    per-replica device timeline attributes every second of it."""
+    from pytorch_distributed_tpu.fleet import (
+        FleetRouter,
+        SLOConfig,
+        prompt_for,
+    )
+    from pytorch_distributed_tpu.telemetry import (
+        DispatchLedger,
+        ReqTracer,
+        busy_summary,
+        cause_histogram,
+    )
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    mlog = MetricsLogger(out_path)
+    reqtrace = ReqTracer(mlog)
+    ledger = DispatchLedger(mlog, seq_source=reqtrace)
+    router = FleetRouter(
+        cfg, params, n_replicas=n_replicas,
+        # saturation bench: spills balance load, sheds would change the
+        # served token count between the 1r and Nr sides
+        slo=SLOConfig(spill_queue_depth=4, shed_queue_depth=10**6),
+        metrics_log=mlog, reqtrace=reqtrace, ledger=ledger,
+        n_slots=slots, block_len=16, prefill_chunk=32, admit_per_step=4,
+    )
+    router.warmup()  # the A/B compares serving, not compile stalls
+    t0 = time.perf_counter()
+    for r in sorted(trace, key=lambda r: (r.t, r.rid)):
+        with ledger.host("tokenize/detokenize"):
+            prompt = prompt_for(r, cfg.vocab_size)
+        router.submit(prompt, r.max_new, session=r.session)
+    while not router.idle:
+        router.step()
+    wall = time.perf_counter() - t0
+    router.log_summary()
+    ledger.finalize()
+    mlog.close()
+    m = router.metrics()
+    return {
+        "wall_s": wall,
+        "tokens": m["tokens_out"],
+        "tok_s": m["tokens_out"] / max(wall, 1e-9),
+        "shed": m["shed"],
+        "busy": busy_summary(ledger.records),
+        "causes": cause_histogram(ledger.records),
+    }
+
+
+def measure_wallclock(trace=None, n_replicas: int = 2, slots: int = 4,
+                      out_path: str | None = None) -> dict:
+    """The ROADMAP-item-3 wall-clock fleet bench: ONE trace served by 1
+    replica vs ``n_replicas``, as fast as the host can crank the loop.
+    Reports aggregate tok/s both sides, per-replica device-busy
+    fraction, and the bubble-cause histogram — which must account for
+    >=90% of the measured 1→N efficiency gap
+    (``serving_wallclock_gap_accounted_frac``; the gap in seconds is
+    ``N x (wallN - tokensN / (N x rate1))``, i.e. the extra aggregate
+    stream-seconds the N-replica run spent vs perfect scaling of the
+    1-replica rate).
+
+    HONESTY (``serving_wallclock_backend``): on CPU all replicas share
+    one device, so N replicas CANNOT beat one — the bench then measures
+    pure host-loop serialization (expect efficiency ~1/N with the gap
+    attributed almost entirely to other-replica-tick), which is the
+    baseline number the async refactor must move. Do not regression-gate
+    CPU magnitudes; the wall-clock keys carry a wide noise band in
+    ``bench_regression.py``."""
+    cfg, params = _tiny_model()
+    if trace is None:
+        trace = default_fleet_trace()
+    side_n = _wallclock_side(cfg, params, trace, n_replicas, slots,
+                             out_path=out_path)
+    side_1 = _wallclock_side(cfg, params, trace, 1, slots)
+    rate1 = side_1["tok_s"]
+    rate_n = side_n["tok_s"]
+    n = n_replicas
+    efficiency = rate_n / max(n * rate1, 1e-9)
+    # the efficiency gap in aggregate stream-seconds: how much longer
+    # the N run's N streams ran vs perfect scaling of the 1r rate
+    ideal_wall = side_n["tokens"] / max(n * rate1, 1e-9)
+    gap_s = n * max(side_n["wall_s"] - ideal_wall, 0.0)
+    bubble_s = sum(c["gap_s"] for c in side_n["causes"].values())
+    accounted = (
+        min(1.0, bubble_s / gap_s) if gap_s > 1e-9 else 1.0
+    )
+    out = {
+        "serving_wallclock_backend": jax.default_backend(),
+        "serving_wallclock_replicas": n,
+        "serving_wallclock_trace_requests": len(trace),
+        "serving_wallclock_slots_per_replica": slots,
+        "serving_wallclock_tokens": side_n["tokens"],
+        "serving_wallclock_wall_s_1r": round(side_1["wall_s"], 3),
+        "serving_wallclock_wall_s_nr": round(side_n["wall_s"], 3),
+        "serving_wallclock_tok_s_1r": round(rate1, 2),
+        "serving_wallclock_tok_s_nr": round(rate_n, 2),
+        "serving_wallclock_ratio_nr_over_1r": round(
+            rate_n / max(rate1, 1e-9), 3
+        ),
+        "serving_wallclock_efficiency_frac": round(efficiency, 4),
+        "serving_wallclock_gap_s": round(gap_s, 3),
+        "serving_wallclock_bubble_s_total": round(bubble_s, 3),
+        "serving_wallclock_bubble_over_gap": round(
+            bubble_s / gap_s, 3
+        ) if gap_s > 1e-9 else None,
+        "serving_wallclock_gap_accounted_frac": round(accounted, 4),
+        "device": str(jax.devices()[0]),
+    }
+    busies = []
+    for rep, s in sorted(side_n["busy"].items()):
+        out[f"serving_wallclock_device_busy_frac_r{rep}"] = s["busy_frac"]
+        busies.append(s["busy_frac"])
+    if busies:
+        out["serving_wallclock_device_busy_frac_mean"] = round(
+            sum(busies) / len(busies), 6
+        )
+    for rep, s in sorted(side_1["busy"].items()):
+        out["serving_wallclock_device_busy_frac_1r"] = s["busy_frac"]
+    for cause, h in sorted(side_n["causes"].items()):
+        key = cause.replace("/", "_").replace("-", "_")
+        out[f"serving_wallclock_bubble_{key}_s"] = round(h["gap_s"], 3)
+        out[f"serving_wallclock_bubble_{key}_count"] = h["count"]
+    return out
+
+
 def link_probe(mb: int = 16, reps: int = 5) -> dict:
     """Same-run bandwidth/link probe, co-quoted with every serving bench
     row (ISSUE 8, ADVICE §6 — the ckpt bench's same-minute disk-probe
@@ -891,6 +1038,14 @@ def main() -> None:
         return
     if "--disagg" in sys.argv:
         print(json.dumps({**measure_disagg(trace=_cli_trace()), **probe}))
+        return
+    if "--wall-clock" in sys.argv:
+        print(json.dumps({**measure_wallclock(
+            trace=_cli_trace(),
+            n_replicas=_argval("--wc-replicas", 2, int),
+            slots=_argval("--wc-slots", 4, int),
+            out_path=_argval("--wc-out", None, str),
+        ), **probe}))
         return
     if "--pressure" in sys.argv:
         print(json.dumps({**measure_pressure(

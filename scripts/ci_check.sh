@@ -10,7 +10,7 @@
 #                             --telemetry-smoke|--warmup-smoke|--reshard-smoke|
 #                             --fleet-smoke|--obs-smoke|--kernel-smoke|
 #                             --pressure-smoke|--trace-smoke|
-#                             --bench-regression]
+#                             --overlap-smoke|--bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -80,6 +80,16 @@
 # AND a handed-off rid (found by predicate, not hard-coded), a
 # Perfetto-loadable Chrome trace must parse, and telemetry_report.py
 # must render the request-trace section (--require spans) (~20 s).
+#
+# --overlap-smoke: lint, then the round-15 host–device overlap cycle:
+# a short seeded trace through the wall-clock fleet driver
+# (bench_serving.py --wall-clock: 2-replica vs 1-replica saturated
+# throughput with the dispatch ledger armed) must report per-replica
+# device-busy fractions and a bubble-cause histogram accounting for
+# >=90% of the measured 1→2 efficiency gap; telemetry_report.py must
+# render the overlap section (--require overlap) from the kept JSONL;
+# and explain_request.py must show a decode window's device-busy vs
+# bubble split on a complete trace (~30 s).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -240,6 +250,43 @@ print(f"perfetto trace: {len(events)} events OK")
 PY
     JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
         "$smoke/spans.jsonl" --json --require spans
+    exit 0
+fi
+
+if [[ "${1:-}" == "--overlap-smoke" ]]; then
+    echo "== overlap smoke (wall-clock 1r-vs-2r -> bubbles account the gap) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py \
+        --gen-trace "$smoke/trace.jsonl" --trace-duration 30 \
+        --trace-base-rate 0.5 --trace-prompt-max 88
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --wall-clock \
+        --trace "$smoke/trace.jsonl" --wc-out "$smoke/overlap.jsonl" \
+        > "$smoke/wallclock.json"
+    python - "$smoke/wallclock.json" <<'PY'
+import json, sys
+row = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert row["serving_wallclock_tok_s_1r"] > 0, row
+assert row["serving_wallclock_tok_s_nr"] > 0, row
+assert "serving_wallclock_device_busy_frac_r0" in row, sorted(row)
+assert "serving_wallclock_device_busy_frac_r1" in row, sorted(row)
+acc = row["serving_wallclock_gap_accounted_frac"]
+assert acc >= 0.9, f"bubbles account for only {acc:.0%} of the gap"
+causes = [k for k in row if k.startswith("serving_wallclock_bubble_")
+          and k.endswith("_s")]
+assert causes, "no bubble-cause histogram keys"
+print(f"wall-clock: {row['serving_wallclock_tok_s_1r']} tok/s 1r vs "
+      f"{row['serving_wallclock_tok_s_nr']} tok/s 2r "
+      f"(backend={row['serving_wallclock_backend']}), "
+      f"gap accounted {acc:.0%}, causes={len(causes)}")
+PY
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/overlap.jsonl" --json --require overlap
+    JAX_PLATFORMS=cpu python scripts/explain_request.py \
+        "$smoke/overlap.jsonl" --find any --assert-complete \
+        | tee "$smoke/explain.txt"
+    grep -q "busy /" "$smoke/explain.txt" \
+        || { echo "explain output missing the device busy/bubble split"; exit 1; }
     exit 0
 fi
 

@@ -105,6 +105,12 @@ class View:
         self.open_spans: set = set()
         self.open_roots: set = set()
         self.span_records = 0
+        # host–device overlap (round 15; kind="overlap"): newest summary
+        # per replica plus a rolling tail of bubbles — busy % and the
+        # top recent bubble cause per replica
+        self.overlap_summary: Dict[int, dict] = {}
+        self.overlap_launches = 0
+        self.recent_bubbles: List[dict] = []
 
     def feed(self, records: List[dict]) -> None:
         for r in records:
@@ -136,6 +142,16 @@ class View:
                     self.swap_bytes += r.get("bytes", 0)
                 else:
                     self.swap_aborts += 1
+            elif kind == "overlap":
+                ev = r.get("ev")
+                if ev == "launch":
+                    self.overlap_launches += 1
+                elif ev == "summary":
+                    self.overlap_summary[r.get("replica", 0)] = r
+                elif ev == "bubble":
+                    self.recent_bubbles.append(r)
+                    if len(self.recent_bubbles) > self.window:
+                        self.recent_bubbles.pop(0)
             elif kind == "span":
                 self.span_records += 1
                 key = (r.get("trace"), r.get("span"))
@@ -146,6 +162,20 @@ class View:
                 elif r.get("ev") == "end":
                     self.open_spans.discard(key)
                     self.open_roots.discard(key)
+
+    def _top_cause(self, replica: int) -> str:
+        """The dominant bubble cause (by gap seconds) in the recent
+        window for one replica — the live "what is this replica waiting
+        on" cell."""
+        by_cause: Dict[str, float] = {}
+        for b in self.recent_bubbles:
+            if b.get("replica") != replica:
+                continue
+            c = b.get("cause", "?")
+            by_cause[c] = by_cause.get(c, 0.0) + b.get("gap_s", 0.0)
+        if not by_cause:
+            return ""
+        return max(by_cause.items(), key=lambda kv: kv[1])[0]
 
     # ---- rendering -------------------------------------------------------
 
@@ -217,6 +247,18 @@ class View:
                     f"{k}={v}" for k, v in
                     sorted(self.preempt_decisions.items())) + "]"
                    if self.preempt_decisions else "")
+            )
+        if self.overlap_summary or self.overlap_launches:
+            cells = []
+            for rep, s in sorted(self.overlap_summary.items()):
+                top = self._top_cause(rep)
+                cells.append(
+                    f"r{rep} busy {s.get('busy_frac', 0.0):.0%}"
+                    + (f" ({top})" if top else "")
+                )
+            out.append(
+                f"overlap  {self.overlap_launches} launches  "
+                + "  ".join(cells)
             )
         fs = self.last.get("fleet_summary")
         if fs:

@@ -1,0 +1,461 @@
+"""Host–device overlap profiler (round 15 tentpole): the dispatch
+ledger's lagged-fence no-hot-sync contract, bubble classification on a
+synthetic two-replica trace, schema-registry replay for
+``kind="overlap"``, Perfetto device tracks + dispatch→device flow
+arrows, the report/--require overlap gate, the explain busy/bubble
+split, trainer step-loop wiring, and rules_threads cleanliness."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.analysis import no_recompile
+from pytorch_distributed_tpu.analysis.core import LintContext, parse_file
+from pytorch_distributed_tpu.analysis.rules_threads import check_threads
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.serving import Scheduler
+from pytorch_distributed_tpu.telemetry import (
+    DispatchLedger,
+    NULL_LEDGER,
+    ReqTracer,
+    busy_summary,
+    busy_within,
+    cause_histogram,
+    chrome_trace,
+    classify_bubbles,
+    device_timeline,
+    validate_stream,
+)
+from pytorch_distributed_tpu.telemetry.overlap import (
+    CAUSE_IDLE,
+    CAUSE_OTHER_REPLICA,
+    DEVICE_PID_BASE,
+)
+from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_script(name):
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(attention="dense", max_seq_len=64)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def _prompts(lens, cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, size=l).astype(np.int32)
+            for l in lens]
+
+
+# ---------------------------------------------------------------------------
+# ledger mechanics: lagged fences, no hot-path sync
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_lagged_fence_targets_only_old_launches(monkeypatch):
+    """The PR 4 LAGGED idiom: launch N's record-keeping may fence ONLY
+    launch N-lag (whose work is long done) — never anything newer. The
+    fence targets are observable through which records got ``fenced``."""
+    led = DispatchLedger(lag=3)
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.ones((8,))
+    outs = []
+    for i in range(8):
+        with led.launch(0, f"p{i}") as lt:
+            y = f(x)
+            lt.handle = y
+        outs.append(y)
+    launches = [r for r in led.records if r["ev"] == "launch"]
+    assert len(launches) == 8
+    # with lag 3, launches 0..4 were fenced by launches 3..7; the last
+    # ``lag`` launches stay unfenced until finalize
+    fenced = [r["program"] for r in launches if r.get("fenced")]
+    assert fenced == [f"p{i}" for i in range(5)]
+    assert led.hot_fences == 0
+    assert led.dead_fences == 0
+    # fences of long-finished work must not have blocked: no fence may
+    # claim a completion (that only happens when the wait exceeded the
+    # blocking epsilon — impossible here, the next dispatch is ms later)
+    for r in launches[:5]:
+        assert "fence_wait_s" in r
+
+
+def test_ledger_fence_on_donated_buffer_is_loud_not_fatal():
+    """A handle registered by mistake on a donated-away buffer must not
+    crash the serve loop — it counts as a dead fence."""
+    led = DispatchLedger(lag=1)
+    f = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+    x = jnp.ones((8,))
+    for i in range(3):
+        with led.launch(0, "donating") as lt:
+            x = f(x)
+            lt.handle = x  # donated into the NEXT call: dead by fence time
+    assert led.dead_fences >= 1
+    assert led.hot_fences == 0
+
+
+def test_ledger_adds_no_programs_and_decode_stays_guarded(model):
+    """Arming the ledger is pure host bookkeeping: the decode program's
+    jit cache must not grow and no implicit transfer may appear — the
+    ``no_recompile``-style no-sync guard with the ledger armed."""
+    cfg, params = model
+    led = DispatchLedger(lag=2)
+    s = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=8,
+                  ledger=led)
+    for p in _prompts([12, 9], cfg):
+        s.submit(p, 4)
+    # warm: first chunk + decode compile here
+    for _ in range(4):
+        s.step()
+    # arm the guard on the live decode program, ledger still attached
+    s.engine._decode_fn = no_recompile(s.engine._decode(), warmup_steps=1)
+    for p in _prompts([10, 11], cfg, seed=1):
+        s.submit(p, 4)
+    s.drain()
+    stats = s.engine._decode_fn.stats
+    assert stats.recompiles_after_warmup == 0
+    assert led.hot_fences == 0
+    assert [r for r in led.records if r["ev"] == "launch"]
+
+
+def test_finalize_idempotent_and_emits_bubbles_summaries(model):
+    cfg, params = model
+    led = DispatchLedger(lag=2)
+    s = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=8,
+                  ledger=led)
+    for p in _prompts([12, 9, 15], cfg):
+        s.submit(p, 4)
+    s.drain()
+    out = led.finalize()
+    assert any(r["ev"] == "bubble" for r in out)
+    assert any(r["ev"] == "summary" for r in out)
+    assert led.finalize() == []  # idempotent
+    summary = busy_summary(led.records)
+    assert 0 < summary[0]["busy_frac"] <= 1.0
+    # bubbles + busy tile the window exactly (accounting closes)
+    bubble_s = sum(r["gap_s"] for r in led.records
+                   if r.get("ev") == "bubble")
+    assert summary[0]["busy_s"] + bubble_s == pytest.approx(
+        summary[0]["window_s"], rel=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# bubble classification on a synthetic two-replica trace
+# ---------------------------------------------------------------------------
+
+
+def _launch(rep, prog, t0, t1, seq0, seq1, done=None):
+    r = {"kind": "overlap", "ev": "launch", "replica": rep,
+         "program": prog, "t0": t0, "t1": t1, "seq0": seq0, "seq1": seq1}
+    if done is not None:
+        r["done"] = done
+    return r
+
+
+def test_synthetic_two_replica_bubble_classification():
+    """Known gaps, known causes: replica 0 idles [1, 2.5] while replica
+    1 runs [1, 2] (other-replica-tick wins by overlap share), then a
+    host mark owns [2.0, 2.5]; an unexplained gap is idle-no-work; edge
+    idle inside the fleet window is attributed too."""
+    recs = [
+        _launch(0, "decode_tick", 0.0, 1.0, 0, 1, done=1.0),
+        _launch(1, "decode_tick", 1.0, 2.0, 2, 3, done=2.0),
+        _launch(0, "decode_tick", 2.5, 3.0, 6, 7, done=3.0),
+        _launch(0, "decode_tick", 4.0, 5.0, 8, 9, done=5.0),
+        {"kind": "overlap", "ev": "host", "replica": 0,
+         "name": "admission/gate", "t0": 2.0, "t1": 2.45,
+         "seq0": 4, "seq1": 5},
+    ]
+    bubbles = classify_bubbles(recs)
+    by_rep = {}
+    for b in bubbles:
+        by_rep.setdefault(b["replica"], []).append(b)
+    r0 = by_rep[0]
+    # gap 1: [1.0, 2.5] — replica 1's tick covers 1.0s of it, the
+    # admission mark 0.45s: other-replica-tick wins
+    assert r0[0]["cause"] == CAUSE_OTHER_REPLICA
+    assert r0[0]["gap_s"] == pytest.approx(1.5)
+    # gap 2: [3.0, 4.0] — nothing overlaps: idle-no-work
+    assert r0[1]["cause"] == CAUSE_IDLE
+    assert r0[1]["gap_s"] == pytest.approx(1.0)
+    # replica 1 has edge bubbles inside the fleet window [0, 5]:
+    # [0, 1] (r0 busy -> other-replica-tick) and [2, 5]
+    r1 = by_rep[1]
+    assert r1[0]["t0"] == pytest.approx(0.0)
+    assert r1[0]["cause"] == CAUSE_OTHER_REPLICA
+    assert sum(b["gap_s"] for b in r1) == pytest.approx(4.0)
+    hist = cause_histogram(recs)
+    assert set(hist) <= {CAUSE_OTHER_REPLICA, CAUSE_IDLE,
+                         "admission/gate"}
+
+
+def test_span_seq_join_attributes_unmarked_gap():
+    """A gap no ledger mark explains joins the round-14 span stream via
+    the shared logical clock: a ``handoff`` span with seq inside the
+    gap's window attributes it to the handoff pump."""
+    recs = [
+        _launch(0, "decode_tick", 0.0, 1.0, 0, 1, done=1.0),
+        _launch(0, "decode_tick", 2.0, 3.0, 8, 9, done=3.0),
+        {"kind": "span", "v": 1, "ev": "begin", "trace": 7, "span": 3,
+         "name": "handoff", "seq": 4, "t": 1.2},
+    ]
+    bubbles = classify_bubbles(recs)
+    assert len(bubbles) == 1
+    assert bubbles[0]["cause"] == "handoff-pump"
+
+
+def test_busy_within_window_split():
+    recs = [
+        _launch(0, "decode_tick", 0.0, 1.0, 0, 1, done=1.0),
+        _launch(0, "decode_tick", 2.0, 3.0, 2, 3, done=3.0),
+    ]
+    busy, bubble = busy_within(recs, 0, 0.5, 2.5)
+    assert busy == pytest.approx(1.0)   # [0.5,1.0] + [2.0,2.5]
+    assert bubble == pytest.approx(1.0)  # [1.0,2.0]
+
+
+def test_device_timeline_monotone_under_lower_bounds():
+    """Async launches without ``done`` collapse to the t1 lower bound,
+    clamped monotone per stream (in-order execution)."""
+    recs = [
+        _launch(0, "chunk", 0.0, 1.0, 0, 1),
+        _launch(0, "chunk", 1.1, 1.2, 2, 3),
+        _launch(0, "decode_tick", 1.3, 5.0, 4, 5, done=5.0),
+    ]
+    slices = device_timeline(recs)[0]
+    ends = [s["end"] for s in slices]
+    assert ends == sorted(ends)
+    assert slices[1]["start"] >= slices[0]["end"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: fleet run -> JSONL -> schema/report/perfetto/explain/top
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_run(model, tmp_path_factory):
+    """A 2-replica fleet served with the ledger + reqtrace sharing one
+    MetricsLogger and one logical clock — the full overlap JSONL."""
+    from pytorch_distributed_tpu.fleet import FleetRouter
+
+    cfg, params = model
+    path = os.fspath(tmp_path_factory.mktemp("overlap") / "run.jsonl")
+    mlog = MetricsLogger(path)
+    reqtrace = ReqTracer(mlog)
+    ledger = DispatchLedger(mlog, seq_source=reqtrace, emit_every=16)
+    router = FleetRouter(
+        cfg, params, n_replicas=2, metrics_log=mlog, reqtrace=reqtrace,
+        ledger=ledger, n_slots=2, block_len=8, prefill_chunk=8,
+        admit_per_step=2,
+    )
+    for i, p in enumerate(_prompts([12, 9, 15, 10, 8, 14], cfg)):
+        router.submit(p, 4, session=i % 3)
+    router.drain()
+    router.log_summary()
+    ledger.finalize()
+    mlog.close()
+    records = [json.loads(l) for l in open(path) if l.strip()]
+    return path, records, ledger
+
+
+def test_overlap_schema_replay(fleet_run):
+    """Every emitted record — spans, requests, overlap launches/hosts/
+    bubbles/summaries — validates against the schema registry."""
+    _path, records, _led = fleet_run
+    assert [r for r in records if r.get("kind") == "overlap"]
+    assert validate_stream(records) == []
+
+
+def test_overlap_jsonl_batched_emission_marked(fleet_run):
+    """The ledger's own JSONL writes are batched off the hot path and
+    self-marked as jsonl-emit host intervals."""
+    _path, records, _led = fleet_run
+    hosts = [r for r in records if r.get("kind") == "overlap"
+             and r.get("ev") == "host"]
+    assert any(r.get("name") == "jsonl-emit" for r in hosts)
+    assert any(r.get("name") == "admission/gate" for r in hosts)
+
+
+def test_perfetto_device_tracks_and_flow_arrows(fleet_run):
+    """The Chrome trace gains one device process per replica (device +
+    dispatch rows) with dispatch→device flow arrows, alongside the
+    per-request span processes."""
+    _path, records, _led = fleet_run
+    trace = chrome_trace(records)
+    events = trace["traceEvents"]
+    dev_pids = {e["pid"] for e in events if e.get("pid", 0)
+                and e["pid"] >= DEVICE_PID_BASE}
+    assert dev_pids == {DEVICE_PID_BASE, DEVICE_PID_BASE + 1}
+    names = {
+        (e["pid"], e.get("args", {}).get("name"))
+        for e in events if e.get("ph") == "M"
+        and e.get("name") == "thread_name"
+    }
+    for pid in dev_pids:
+        assert (pid, "device") in names
+        assert (pid, "dispatch") in names
+    # busy slices on the device row, dispatch walls on the dispatch row
+    for pid in dev_pids:
+        assert any(e.get("ph") == "X" and e["pid"] == pid
+                   and e["tid"] == 0 for e in events)
+        assert any(e.get("ph") == "X" and e["pid"] == pid
+                   and e["tid"] == 1 for e in events)
+    flows = [e for e in events if e.get("cat") == "dispatch"]
+    assert any(e["ph"] == "s" for e in flows)
+    assert any(e["ph"] == "f" for e in flows)
+    json.dumps(trace)  # serializable == Perfetto-loadable shape
+
+
+def test_report_overlap_section_and_require_gate(fleet_run, capsys):
+    report = _import_script("telemetry_report")
+    path, _records, _led = fleet_run
+    assert report.main([path, "--json", "--require", "overlap"]) == 0
+    out = capsys.readouterr().out
+    assert "overlap & bubbles" in out
+    row = json.loads(out.strip().splitlines()[-1])
+    assert row["overlap_replicas"] == 2
+    assert row["overlap_launches"] > 0
+    assert row["overlap_bubble_s_total"] > 0
+    assert "overlap_busy_frac_r0" in row
+    assert "overlap_d2c_p95_ms_decode_tick" in row
+
+
+def test_report_require_overlap_fails_without_records(tmp_path, capsys):
+    report = _import_script("telemetry_report")
+    path = os.fspath(tmp_path / "plain.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "goodput", "goodput_frac": 1.0,
+                            "productive_s": 1.0, "wall_s": 1.0}) + "\n")
+    assert report.main([path, "--require", "overlap"]) == 2
+    capsys.readouterr()
+
+
+def test_explain_decode_window_busy_bubble_split(fleet_run, capsys):
+    explain = _import_script("explain_request")
+    path, records, _led = fleet_run
+    rid = next(r["trace"] for r in records if r.get("kind") == "span")
+    assert explain.main([path, "--rid", str(rid)]) == 0
+    out = capsys.readouterr().out
+    assert "busy /" in out and "bubble]" in out
+    assert "decode device split:" in out
+
+
+def test_pdt_top_overlap_row(fleet_run):
+    top = _import_script("pdt_top")
+    _path, records, _led = fleet_run
+    view = top.View()
+    view.feed(records)
+    lines = view.lines()
+    row = next(l for l in lines if l.startswith("overlap"))
+    assert "busy" in row and "launches" in row
+
+
+# ---------------------------------------------------------------------------
+# trainer wiring + lint cleanliness
+# ---------------------------------------------------------------------------
+
+
+def test_lm_trainer_overlap_ledger(tmp_path):
+    """``LMTrainerConfig.overlap`` arms the ledger over the trainer's
+    JSONL: lm_train_step launches land with lagged fences on the step's
+    metrics outputs, eval launches ride the t1 bound, and finalize's
+    bubbles/summaries reach the stream."""
+    from pytorch_distributed_tpu.data.tokens import SyntheticTokens
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.train import LMTrainer, LMTrainerConfig
+
+    mesh = make_mesh(jax.devices()[:1], data_parallel=1, seq_parallel=1,
+                     model_parallel=1)
+    cfg = LMTrainerConfig(
+        epochs=1, batch_size=2, lr=1e-2,
+        save_dir=os.fspath(tmp_path / "lm"), num_workers=0, log_every=1,
+        warmup_steps=0, overlap=True,
+    )
+    train = SyntheticTokens(size=12, seq_len=32, vocab_size=128)
+    val = SyntheticTokens(size=8, seq_len=32, vocab_size=128, seed=9)
+    t = LMTrainer(tiny_config(attention="dense"), train, val, cfg,
+                  mesh=mesh)
+    t.fit()
+    t.metrics_log.close()
+    records = [json.loads(l)
+               for l in open(os.path.join(cfg.save_dir, "metrics.jsonl"))]
+    launches = [r for r in records if r.get("kind") == "overlap"
+                and r.get("ev") == "launch"]
+    assert sum(r["program"] == "lm_train_step" for r in launches) == 6
+    assert any(r["program"] == "lm_eval_step" for r in launches)
+    assert any(r.get("fenced") for r in launches)
+    assert any(r.get("ev") == "summary" for r in records
+               if r.get("kind") == "overlap")
+    assert t.ledger.hot_fences == 0
+    assert t.ledger.dead_fences == 0
+    assert validate_stream(records) == []
+
+
+def test_null_ledger_is_inert(model):
+    """Schedulers default to NULL_LEDGER: no records, no fences, and
+    the with-block token still accepts a handle."""
+    with NULL_LEDGER.launch(0, "p") as lt:
+        lt.handle = jnp.ones(())
+    assert NULL_LEDGER.records == []
+    cfg, params = model
+    s = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=8)
+    assert s.ledger is NULL_LEDGER
+    assert s.engine.ledger is NULL_LEDGER
+
+
+def test_bench_regression_wallclock_bands_and_direction():
+    """Round-15 satellite: wall-clock keys carry the wide machine-wall
+    band, device-busy fraction is direction-aware (a halved busy frac
+    flags; fractions are otherwise skipped), and the accounted-gap
+    fraction is tightly banded."""
+    br = _import_script("bench_regression")
+    assert br.direction("serving_wallclock_device_busy_frac_r0") == "up"
+    assert br.direction("serving_wallclock_efficiency_frac") is None
+    assert br.band_for("serving_wallclock_tok_s_1r", {}) == 1.5
+    flagged = br.compare(
+        {"serving_wallclock_device_busy_frac_r0": 0.1},
+        {"serving_wallclock_device_busy_frac_r0": 0.3},
+    )
+    assert [r["key"] for r in flagged["regressions"]] == [
+        "serving_wallclock_device_busy_frac_r0"
+    ]
+    # machine-wall weather inside the wide band does not page anyone
+    calm = br.compare(
+        {"serving_wallclock_tok_s_1r": 1500.0},
+        {"serving_wallclock_tok_s_1r": 2600.0},
+    )
+    assert not calm["regressions"]
+
+
+def test_rules_threads_passes_overlap_module_clean():
+    ctx = LintContext(modules=[], mesh_axes=set(), axis_constants={})
+    mod = parse_file(
+        os.path.join(REPO, "pytorch_distributed_tpu/telemetry/overlap.py"),
+        REPO,
+    )
+    findings = check_threads(mod, ctx)
+    assert findings == [], [f.render() for f in findings]
