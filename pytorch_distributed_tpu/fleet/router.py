@@ -19,6 +19,15 @@ collectives are a known jaxlib CPU gap). Requests enter through
 - **one host loop**: ``step()`` ticks every replica once — decode
   replicas first (their token sync never waits behind freshly dispatched
   prefill work), then prefill/mixed replicas, then the handoff pump.
+  **Round 16 (``async_host=True``)** turns that loop into
+  dispatch-then-collect: every replica's compiled tick is LAUNCHED
+  back-to-back (JAX async dispatch — nothing materializes), results are
+  drained one tick LAGGED (the PR 4 metrics-ring idiom), and the
+  per-request host work rides a small ``HostWorkerPool`` — so replica
+  B's device no longer sits idle for replica A's tokenize/JSONL/gate
+  math. Greedy token streams are bit-identical between the two loops
+  (per replica, collect(N−1) → dispatch(N) IS the synchronous
+  schedule); ``async_host=False`` stays the step-domain reference.
 
 Disaggregated prefill/decode (``disaggregate=True``): the first
 ``n_prefill`` replicas run ``prefill_only`` schedulers — chunk programs
@@ -81,6 +90,7 @@ class FleetRouter:
                  slo: Optional[SLOConfig] = None, devices=None,
                  seed: int = 0, metrics_log=None, tracer=None,
                  flightrec=None, reqtrace=None, ledger=None,
+                 async_host: bool = False, host_threads: int = 2,
                  **scheduler_kwargs):
         import jax
 
@@ -119,6 +129,19 @@ class FleetRouter:
         # attributed to replica A's tick — the one-loop serialization
         # ROADMAP item 3's async refactor must remove
         self.ledger = ledger if ledger is not None else NULL_LEDGER
+        # async host runtime (round 16; ROADMAP item 3): dispatch-then-
+        # collect replica ticks + ONE worker pool shared by every
+        # replica for the off-critical-path host work (JSONL emission,
+        # gate-metric percentile math). async_host=False keeps the
+        # synchronous loop bit-for-bit — the step-domain A/B reference.
+        self.async_host = bool(async_host)
+        self.host_pool = None
+        if self.async_host:
+            from pytorch_distributed_tpu.serving.host_worker import (
+                HostWorkerPool,
+            )
+
+            self.host_pool = HostWorkerPool(n_threads=host_threads)
         self.replicas: List[Scheduler] = []
         self.roles: List[str] = []
         for i in range(n_replicas):
@@ -145,7 +168,8 @@ class FleetRouter:
                 prefill_only=(role == "prefill"), device=dev,
                 handoff=disaggregate, metrics_log=metrics_log,
                 tracer=tracer, flightrec=self.flightrec,
-                reqtrace=self.reqtrace, ledger=self.ledger, **kw,
+                reqtrace=self.reqtrace, ledger=self.ledger,
+                host_pool=self.host_pool, **kw,
             ))
             self.roles.append(role)
         self.disaggregated = disaggregate
@@ -182,7 +206,11 @@ class FleetRouter:
     # ---- routing ----
 
     def _group_metrics(self, group: List[int]) -> Dict[int, dict]:
-        return {i: self.replicas[i].metrics() for i in group}
+        # gate_metrics == metrics() on the synchronous loop; under the
+        # async loop it is the worker-refreshed snapshot + live cheap
+        # counters, so per-submit routing stops paying the O(n log n)
+        # percentile math on the critical path
+        return {i: self.replicas[i].gate_metrics() for i in group}
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int, *,
                session: Optional[int] = None) -> int:
@@ -326,16 +354,38 @@ class FleetRouter:
                 budget -= 1
 
     def step(self) -> List[Tuple[int, int]]:
-        """One fleet tick: decode replicas first (their token sync stays
-        clear of this tick's fresh prefill dispatches), then
-        prefill/mixed replicas, then the handoff pump."""
+        """One fleet tick. Synchronous loop: tick each replica fully —
+        decode replicas first (their token sync stays clear of this
+        tick's fresh prefill dispatches), then prefill/mixed replicas,
+        then the handoff pump. Async loop (``async_host=True``):
+        **dispatch-then-collect** — first COLLECT every replica's
+        previous tick (lagged: those ticks have been in flight across
+        the pump and all inter-step host work), then DISPATCH every
+        replica's next tick back-to-back so every compiled program is
+        enqueued before any of this step's host work runs, then the
+        pump. Per replica the order collect(N−1) → dispatch(N) is the
+        synchronous schedule, so greedy token streams are bit-identical
+        between modes; only cross-replica interleaving (and the wall
+        clock) changes."""
         if self._start_time is None:
             self._start_time = time.perf_counter()
         out: List[Tuple[int, int]] = []
-        for i in self.decode_group:
-            out.extend(self.replicas[i].step())
-        for i in self.entry_group:
-            out.extend(self.replicas[i].step())
+        order = self.decode_group + self.entry_group
+        if self.async_host:
+            # interleaved collect/dispatch: while replica i's freshly
+            # dispatched tick N is in flight, the loop is already
+            # collecting replica i+1's tick N−1 and building its tick N
+            # — every replica's dispatch-side host work (admissions,
+            # chunk batch build, table masking) overlaps some OTHER
+            # replica's device work, which a collect-all-then-
+            # dispatch-all phasing would leave serialized against an
+            # idle device
+            for i in order:
+                out.extend(self.replicas[i].collect_tick())
+                self.replicas[i].dispatch_tick()
+        else:
+            for i in order:
+                out.extend(self.replicas[i].step())
         if self.decode_group:
             with self.ledger.host("handoff-pump"):
                 self._pump_handoffs()
@@ -350,14 +400,24 @@ class FleetRouter:
     @property
     def idle(self) -> bool:
         # Scheduler.idle counts parked and mid-swap requests as
-        # in-flight work, so a drain never strands a preempted stream
-        return all(s.idle for s in self.replicas)
+        # in-flight work, so a drain never strands a preempted stream;
+        # has_uncollected keeps the async loop stepping until every
+        # in-flight tick's tokens have been collected AND delivered
+        return all(
+            s.idle and not s.has_uncollected for s in self.replicas
+        )
 
     def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Step until every replica is empty; returns ``{rid: [tokens]}``
         for every request that produced output (shed rids absent)."""
         for _ in range(max_steps):
             if self.idle:
+                if self.host_pool is not None:
+                    # barrier: offloaded JSONL/metric work settles with
+                    # the drain, same as the synchronous loop's contract
+                    for s in self.replicas:
+                        s.flush_host_work()
+                    self.host_pool.flush()
                 return dict(self.results)
             self.step()
         raise RuntimeError(
@@ -439,12 +499,33 @@ class FleetRouter:
             "restores": sum(m["restores"] for m in per),
             "parked": sum(m["parked"] for m in per),
             "swap_bytes": sum(m["swap_bytes"] for m in per),
+            "swap_aborts": sum(m["swap_aborts"] for m in per),
             "preempt_rate": (
                 sum(m["preempts"] for m in per) / placed if placed else 0.0
             ),
             "recommended_replicas": self.recommend_replicas(),
             "recommended_replicas_peak": self._recommend_peak,
+            "async_host": self.async_host,
         }
+        # host–device overlap rollup (round 16): per-replica device-busy
+        # fractions PLUS the interval-union fraction. On a shared device
+        # (the CPU simulation) a replica's dispatch→completion window
+        # includes time queued behind the other replicas, so per-replica
+        # fractions overlap and must not be summed — the union is true
+        # device utilization, backend-marked (gather_ab_backend pattern)
+        if self.ledger.enabled:
+            from pytorch_distributed_tpu.telemetry.overlap import (
+                fleet_busy_summary,
+            )
+
+            fb = fleet_busy_summary(self.ledger.snapshot())
+            if fb["replicas"]:
+                import jax
+
+                out["device_busy_frac_union"] = fb["union_busy_frac"]
+                out["device_busy_backend"] = jax.default_backend()
+                for rep, frac in sorted(fb["replicas"].items()):
+                    out[f"r{rep}_device_busy_frac"] = frac
         out.update(self.handoff_lat.summary("handoff"))
         for name in ("ttft", "token_lat", "queue_wait"):
             vals: List[float] = []
@@ -465,7 +546,13 @@ class FleetRouter:
 
     def log_summary(self) -> None:
         """One ``kind="fleet_summary"`` JSONL record — the fleet half of
-        what ``scripts/telemetry_report.py`` renders."""
+        what ``scripts/telemetry_report.py`` renders. Flushes the async
+        host workers first so every offloaded per-request record lands
+        before the summary that aggregates them."""
+        if self.host_pool is not None:
+            for s in self.replicas:
+                s.flush_host_work()
+            self.host_pool.flush()
         if self.metrics_log is not None:
             with self.ledger.host("jsonl-emit"):
                 self.metrics_log.log(kind="fleet_summary", **self.metrics())

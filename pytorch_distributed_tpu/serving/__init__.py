@@ -28,6 +28,13 @@ Round 12: the read path gains its fused Pallas kernel
 gather) and the pool an int8 quantized variant (``kv_dtype="int8"``,
 per-row scales, ~2x blocks at fixed bytes) — ANALYSIS.md "Paged
 attention kernel & quantized KV".
+
+Round 16: the async host runtime — ``scheduler`` splits each tick into
+a non-blocking ``dispatch_tick`` and a lagged ``collect_tick``
+(``engine.decode_launch``/``decode_collect``), and ``host_worker``
+provides the thread pool the off-critical-path host work (JSONL, gate
+percentile math) runs on; ``fleet.FleetRouter(async_host=True)`` is
+the driver — ANALYSIS.md "Async host runtime".
 """
 
 from pytorch_distributed_tpu.serving.kv_pool import (
@@ -50,7 +57,12 @@ from pytorch_distributed_tpu.serving.engine import (
     PagedEngine,
     PendingSwap,
 )
-from pytorch_distributed_tpu.serving.scheduler import Request, Scheduler
+from pytorch_distributed_tpu.serving.host_worker import HostWorkerPool
+from pytorch_distributed_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+    TickHandle,
+)
 
 __all__ = [
     "KV_DTYPES",
@@ -69,6 +81,8 @@ __all__ = [
     "KVExport",
     "PagedEngine",
     "PendingSwap",
+    "HostWorkerPool",
     "Request",
     "Scheduler",
+    "TickHandle",
 ]

@@ -990,38 +990,85 @@ class PagedEngine:
         fn = self._chunk_fn(k_pad, wp)
         # no fence handle: both outputs are donated into later programs,
         # so completion rides the t1 lower bound tightened by the next
-        # sync launch on this replica stream (the decode tick)
+        # sync launch on this replica stream (the decode tick).
         with self.ledger.launch(self.ledger_replica,
                                 self.chunk_program_name(k_pad, wp)):
+            # ONE batched explicit transfer for the six host-built
+            # operands, inside the launch window (dispatch cost; see
+            # the decode call's note on the per-operand asarray tax)
+            operands = jax.device_put(
+                (tokens, starts, tables, slots, is_last, last_idx)
+            )
             self.cache, self.logits = fn(
-                self.params, self.cache, self.logits, jnp.asarray(tokens),
-                jnp.asarray(starts), jnp.asarray(tables),
-                jnp.asarray(slots), jnp.asarray(is_last),
-                jnp.asarray(last_idx),
+                self.params, self.cache, self.logits, *operands,
             )
         self._hot_chunks.add((k_pad, wp))
 
-    def decode(self, positions: np.ndarray, active: np.ndarray, rng):
-        """One decode tick for every slot; samples from the logits
-        buffer, writes each active lane's token at its position, returns
-        ``(tokens [n_slots], new_positions)``. Inactive lanes compute
-        dead garbage routed to the trash block."""
+    def _decode_call(self, positions, active, rng, sync: bool):
+        """One decode-tick launch, shared by the sync and async host
+        paths. ``sync=True`` materializes the tokens INSIDE the ledger
+        window (t1 is exact completion — the historical ``decode``
+        contract); ``sync=False`` returns device arrays plus the launch
+        token so the caller can pin completion at its own collect site
+        (``DispatchLedger.complete``)."""
         masked = np.where(active[:, None], self.tables, TRASH_BLOCK)
         fn = self._decode()
         if self.device is not None:
             # keys are computed arrays; pin them next to the replica's
             # committed working set so the program has one placement
             rng = jax.device_put(rng, self.device)
-        # sync launch: the token fetch inside the window materializes
-        # the program's result, so t1 IS device completion — the exact
-        # anchor the chunk launches' lower bounds tighten against
         with self.ledger.launch(self.ledger_replica, self.DECODE_PROGRAM,
-                                sync=True):
+                                sync=sync) as lt:
+            # ONE batched explicit transfer for the host-built
+            # operands, inside the launch window — it is dispatch cost.
+            # The per-operand eager jnp.asarray spelling paid python
+            # bind overhead three times per tick (a third of the serve
+            # loop's host wall, round-16 profile), and a bare-np jit
+            # call would be an IMPLICIT transfer the no_recompile guard
+            # rightly rejects.
+            positions, active, masked = jax.device_put(
+                (np.asarray(positions, np.int32), active, masked)
+            )
             self.cache, self.logits, positions, tokens = fn(
                 self.params, self.cache, self.logits,
-                jnp.asarray(positions, jnp.int32), jnp.asarray(active),
-                jnp.asarray(masked), rng,
+                positions, active, masked, rng,
             )
-            tokens = np.asarray(tokens)
+            if sync:
+                # the token fetch inside the window materializes the
+                # program's result, so t1 IS device completion — the
+                # exact anchor the chunk launches' lower bounds tighten
+                # against
+                tokens = np.asarray(tokens)
+            else:
+                lt.handle = tokens  # non-donated output: fence target
         self._hot_decode = True
+        return tokens, positions, lt
+
+    def decode(self, positions: np.ndarray, active: np.ndarray, rng):
+        """One decode tick for every slot; samples from the logits
+        buffer, writes each active lane's token at its position, returns
+        ``(tokens [n_slots], new_positions)``. Inactive lanes compute
+        dead garbage routed to the trash block."""
+        tokens, positions, _ = self._decode_call(positions, active, rng,
+                                                 sync=True)
         return tokens, np.array(positions)
+
+    def decode_launch(self, positions: np.ndarray, active: np.ndarray,
+                      rng):
+        """The async host path's non-blocking decode tick (round 16):
+        dispatches the SAME compiled program as ``decode`` — identical
+        shapes, zero new registry entries — and returns
+        ``(device_tokens, device_positions, launch_token)`` WITHOUT
+        materializing anything. The caller materializes later through
+        ``decode_collect`` while this device (or another replica's) is
+        already running the next program."""
+        return self._decode_call(positions, active, rng, sync=False)
+
+    def decode_collect(self, tokens, positions, launch_token):
+        """Materialize a ``decode_launch``'s results: pins the launch's
+        completion on the ledger (a collect-site fence — by now the
+        work is usually done and the wait is a no-op), then fetches
+        tokens and positions to host. Returns the same
+        ``(tokens [n_slots], new_positions)`` as ``decode``."""
+        self.ledger.complete(launch_token)
+        return np.asarray(tokens), np.array(positions)

@@ -61,6 +61,29 @@ instead of arming decode, and ``peek_ready``/``complete_handoff`` +
 (``PagedEngine.export_chain``/``import_chain``) — the disaggregated
 prefill/decode split.
 
+Async host runtime (round 16; ANALYSIS.md "Async host runtime"):
+``step()`` is now a thin wrapper over a **dispatch/collect split** —
+``dispatch_tick()`` runs admissions, the chunk program, and a
+NON-BLOCKING decode launch (``PagedEngine.decode_launch``: JAX async
+dispatch returns before device completion), parking a ``TickHandle``;
+``collect_tick()`` materializes the parked tick's tokens and does all
+per-token host work (TTFT, retirement, JSONL). The fleet router's
+``async_host=True`` loop drives the halves LAGGED — collect tick N−1,
+then dispatch tick N back-to-back on every replica — so one replica's
+host work overlaps the others' in-flight device work. Per replica the
+order collect(N−1) → dispatch(N) is exactly the synchronous schedule,
+which is why token streams are bit-identical between modes. Any entry
+point that mutates decode-armed state from OUTSIDE the tick cycle
+(``preempt``/``preempt_lru``/``begin_drain``) collects the pending
+tick first, so an in-flight decode can never race a chain release.
+``host_pool`` (a ``serving.host_worker.HostWorkerPool``) moves
+per-request JSONL emission and the gate-metrics percentile math onto
+worker threads; ``gate_metrics()`` is the router's routing view —
+worker-refreshed percentile snapshot overlaid with LIVE cheap counters
+(queue depth, occupancy, preemptible), so depth-bound SLO decisions
+stay deterministic while the O(n log n) percentile work leaves the
+critical path.
+
 Lifecycle tracing (round 14; ANALYSIS.md "Request-lifecycle tracing"):
 pass ``reqtrace`` (a ``telemetry.ReqTracer``) and every request becomes
 one causal span tree — queued → prefill (per-chunk events naming the
@@ -76,9 +99,10 @@ resulting ``kind="span"`` JSONL.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -93,6 +117,7 @@ from pytorch_distributed_tpu.telemetry import (
     GoodputLedger,
     LatencySeries,
     ProgramTimes,
+    percentiles,
 )
 
 
@@ -164,6 +189,27 @@ class Request:
         return int(len(self.tokens))
 
 
+class TickHandle(NamedTuple):
+    """One dispatched-but-uncollected scheduler tick (round 16).
+
+    ``tokens`` is the decode program's token output — a DEVICE array on
+    the async path (materialized at collect), an np array on the sync
+    path (materialized inside the ledger window), or None when the tick
+    had no active decode lane. ``lanes`` are the slots that were active
+    at dispatch, in slot order — collect processes exactly these, and
+    the no-external-mutation protocol (preempt/drain collect first)
+    guarantees each is still resident at collect time."""
+
+    tokens: object
+    positions: object
+    launch: object  # engine launch token (None for sync / no-decode)
+    lanes: Tuple[int, ...]
+    t_step0: float
+    t_dec: float
+    cold_decode: bool
+    sync: bool
+
+
 class Scheduler:
     """Continuous paged-KV scheduler: ``submit`` enqueues, ``step``
     advances the whole system one tick, ``drain`` runs to empty.
@@ -187,7 +233,7 @@ class Scheduler:
                  swap_policy: str = "auto", protect_ticks: int = 2,
                  host_store=None,
                  host_store_max_bytes: Optional[int] = None,
-                 reqtrace=None, ledger=None):
+                 reqtrace=None, ledger=None, host_pool=None):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
         from pytorch_distributed_tpu.serving.kv_pool import HostBlockStore
 
@@ -320,6 +366,36 @@ class Scheduler:
         self.ledger = ledger if ledger is not None else NULL_LEDGER
         self.engine.ledger = self.ledger
         self.engine.ledger_replica = replica_id
+        # ---- async host runtime (round 16) ----
+        # the dispatched-but-uncollected tick (main-thread-only state:
+        # only dispatch_tick/collect_tick and the early-collect hooks
+        # in preempt/begin_drain touch it)
+        self._pending_tick: Optional[TickHandle] = None
+        # tokens collected outside the router's collect phase (an early
+        # collect forced by preempt/drain) — delivered at the next
+        # collect_tick so no token is ever dropped or double-delivered
+        self._collected: List[Tuple[int, int]] = []
+        # optional worker pool (serving.host_worker.HostWorkerPool):
+        # per-request JSONL emission and the gate-metrics percentile
+        # math run there; everything a worker touches is either
+        # self-locked (logger/tracer/ledger), copied at enqueue, or the
+        # snapshot below under its dedicated lock
+        self.host_pool = host_pool
+        self._gate_cache: Optional[dict] = None
+        self._gate_lock = threading.Lock()
+        #: ticks between gate-snapshot refreshes. Refreshing every
+        #: collect measurably drags the loop (one task + two list
+        #: copies per tick); the gate's percentile rungs tolerate
+        #: staleness by design — the depth-bound rungs ride the LIVE
+        #: overlays in gate_metrics and never go stale at all.
+        self.gate_refresh_ticks = 32
+        self._gate_refreshed_step = -(10**9)
+        # batched sentinel feed (async mode): per-tick observations
+        # buffer here (main thread) and ship to a worker as ONE task
+        # per batch — a task per tick measurably dragged the loop
+        # (queue hop + GIL churn ~2x/tick)
+        self._tick_obs: List[Tuple[float, float, int]] = []
+        self.tick_obs_batch = 32
         # anomaly sentinel over tick time / TTFT / queue depth; a recent
         # hit surfaces as metrics()["anomaly_recent"], which the fleet
         # SLOGate reads as a hot signal (spill around this replica)
@@ -604,6 +680,10 @@ class Scheduler:
         """Preempt the least-recently-served eligible victim; returns
         its rid (None when nothing is preemptible — the caller's cue
         that shedding really is the last resort)."""
+        # async host loop: an in-flight tick may be decoding the victim
+        # — collect it first so the victim's produced/generated state is
+        # current and its chain release cannot race the launched program
+        self._collect_pending_tick()
         for _, rid, _slot in self._victims():
             if self.preempt(rid, reason=reason) is not None:
                 return rid
@@ -617,6 +697,8 @@ class Scheduler:
         and the request is restored — before its next decode — by
         ``_restore_parked`` once capacity allows. Returns the
         ``SwapDecision`` (None when the request is not preemptible)."""
+        # same in-flight hazard as preempt_lru (direct callers exist)
+        self._collect_pending_tick()
         slot = next(
             (s for s, r in self.resident.items() if r.rid == rid), None
         )
@@ -894,10 +976,19 @@ class Scheduler:
             ))
         return jobs
 
-    def step(self) -> List[Tuple[int, int]]:
-        """One tick: admissions → one prefill chunk per unfinished prompt
-        (ONE compiled program) → one decode token per ready lane →
-        retirements. Returns ``[(rid, token)]``."""
+    def dispatch_tick(self, sync: bool = False) -> None:
+        """The non-blocking half of one tick: restores/admissions → one
+        prefill chunk per unfinished prompt (ONE compiled program) →
+        the decode program LAUNCHED (not materialized). Parks a
+        ``TickHandle`` for ``collect_tick``. ``sync=True`` (the
+        synchronous loop, via ``step``) materializes the tokens inside
+        the launch window instead — the historical exact-completion
+        ledger anchor."""
+        if self._pending_tick is not None:
+            raise RuntimeError(
+                "collect_tick() must drain the pending tick before "
+                "another dispatch (one tick in flight per replica)"
+            )
         if self._start_time is None:
             self._start_time = time.perf_counter()
         t_step0 = time.perf_counter()
@@ -978,9 +1069,23 @@ class Scheduler:
         self._occupancy_sum += len(self.resident) / self.n_slots
         self._step_count += 1
         if not active.any():
-            self._observe_tick(t_step0)
-            return []
-        self._rng, sub = jax.random.split(self._rng)
+            self._pending_tick = TickHandle(
+                None, None, None, (), t_step0, t_step0, False, sync,
+            )
+            return
+        if self.engine.temperature == 0.0:
+            # greedy: _sample is a pure argmax and never reads the key
+            # — the per-tick threefry split was ~14% of the serve
+            # loop's host wall (round-16 profile) spent preparing an
+            # unused input. The key still rides along (same program
+            # signature, zero recompiles); sampled runs split as ever.
+            sub = self._rng
+        else:
+            with self.ledger.host("sampling-prep", self.replica_id):
+                # sampling-param prep: the per-tick key split (host-side
+                # dispatch of a tiny program) — marked so its share of
+                # any bubble is attributable
+                self._rng, sub = jax.random.split(self._rng)
         cold_decode = not self.engine.has_decode_program
         if cold_decode:
             # every active lane's token this tick arrives through the
@@ -990,19 +1095,94 @@ class Scheduler:
         t_dec = time.perf_counter()
         with self.tracer.span("decode_tick", lanes=int(active.sum())), \
                 attribute_compile(self.goodput if cold_decode else None):
-            tokens, self.positions = self.engine.decode(
-                self.positions, active, sub
+            if sync:
+                tokens, positions = self.engine.decode(
+                    self.positions, active, sub
+                )
+                launch = None
+            else:
+                tokens, positions, launch = self.engine.decode_launch(
+                    self.positions, active, sub
+                )
+        lanes = tuple(int(s) for s in np.nonzero(active)[0])
+        self._pending_tick = TickHandle(
+            tokens, positions, launch, lanes, t_step0, t_dec,
+            cold_decode, sync,
+        )
+
+    def collect_tick(self) -> List[Tuple[int, int]]:
+        """The blocking half: materialize the pending tick's tokens and
+        run all per-token host work (TTFT/latency series, retirement,
+        JSONL). Returns ``[(rid, token)]`` — including anything an
+        early collect (preempt/drain) stashed since the last call.
+        No-op without a pending tick."""
+        self._collect_pending_tick()
+        out, self._collected = self._collected, []
+        return out
+
+    @property
+    def has_uncollected(self) -> bool:
+        """True while a token-bearing tick is in flight or collected
+        tokens await delivery — the router's drain loop must keep
+        stepping (``idle`` alone reads host state, which a pending tick
+        is about to change)."""
+        h = self._pending_tick
+        return bool(self._collected) or (
+            h is not None and h.tokens is not None
+        )
+
+    def _collect_pending_tick(self) -> None:
+        h = self._pending_tick
+        if h is None:
+            return
+        self._pending_tick = None
+        if h.tokens is None:
+            self._observe_tick(h.t_step0)
+            return
+        if h.sync:
+            tokens, positions = h.tokens, np.array(h.positions)
+        else:
+            tokens, positions = self.engine.decode_collect(
+                h.tokens, h.positions, h.launch
             )
-        # engine.decode returns MATERIALIZED numpy tokens, so this
-        # timestamp is token-delivery time, not dispatch time
+        # write back ONLY the lanes this tick decoded: rows the host
+        # armed since the launch (an adopted handoff chain, a restored
+        # swap) must not be clobbered by the device's frozen copies
+        lanes = np.asarray(h.lanes, np.int64)
+        self.positions[lanes] = positions[lanes]
+        # tokens materialized above, so this timestamp is
+        # token-delivery time, not dispatch time
         now = time.perf_counter()
-        if not cold_decode:
-            # cost-card join: tokens materialized above, so this wall is
-            # dispatch + device + sync — the honest decode-tick cost
-            self.prog_times.observe(self.engine.DECODE_PROGRAM, now - t_dec)
+        if not h.cold_decode:
+            # cost-card join: dispatch + device + sync — the honest
+            # decode-tick cost (on the async path the sync lands here,
+            # at collect, where the stream actually pays it)
+            self.prog_times.observe(self.engine.DECODE_PROGRAM,
+                                    now - h.t_dec)
         out: List[Tuple[int, int]] = []
-        for slot in np.nonzero(active)[0]:
-            slot = int(slot)
+        # collect-side host work under its own mark: the one-loop async
+        # A/B needs "processing replica i's tokens" visible as a cause
+        # when it serializes another replica's gap. Entered manually so
+        # the 50-line loop below keeps its indentation; the finally at
+        # the end of this method closes it on every path.
+        collect_mark = self.ledger.host("tick-collect", self.replica_id)
+        collect_mark.__enter__()
+        try:
+            self._process_collected(h, tokens, now, out)
+        finally:
+            collect_mark.__exit__(None, None, None)
+        self._collected.extend(out)
+        if (self.host_pool is not None and out
+                and self._step_count - self._gate_refreshed_step
+                >= self.gate_refresh_ticks):
+            self._gate_refreshed_step = self._step_count
+            self._queue_gate_refresh()
+
+    def _process_collected(self, h: TickHandle, tokens, now: float,
+                           out: List[Tuple[int, int]]) -> None:
+        """Per-token host work for one collected tick: latency series,
+        stream bookkeeping, retirement (slot + chain release), JSONL."""
+        for slot in h.lanes:
             req = self.resident[slot]
             token = int(tokens[slot])
             out.append((req.rid, token))
@@ -1055,9 +1235,19 @@ class Scheduler:
             else:
                 self.remaining[slot] -= 1
         if out:
-            self.tick_lat.observe(now - t_step0)
-        self._observe_tick(t_step0)
-        return out
+            self.tick_lat.observe(now - h.t_step0)
+        self._observe_tick(h.t_step0)
+
+    def step(self) -> List[Tuple[int, int]]:
+        """One synchronous tick: dispatch + same-tick collect (the
+        historical contract — admissions → one prefill chunk per
+        unfinished prompt → one decode token per ready lane →
+        retirements). Returns ``[(rid, token)]``. Any tick left pending
+        by an async driver is collected first, so mode mixing never
+        drops a token."""
+        out = self.collect_tick()
+        self.dispatch_tick(sync=True)
+        return out + self.collect_tick()
 
     def _note_anomaly(self, hit: Optional[dict]) -> None:
         if hit is not None:
@@ -1065,22 +1255,74 @@ class Scheduler:
 
     def _observe_tick(self, t_step0: float) -> None:
         """Per-tick sentinel feed: tick wall and queue depth (every tick,
-        both return paths of ``step``)."""
+        both return paths of ``step``). With a host pool the median/MAD
+        math (a measured ~15% of the serve loop's host wall) runs on a
+        worker — the sentinel is internally locked, the fed values are
+        captured here, and a hit latches ``_last_anomaly_step`` to the
+        captured tick (a single int store; monotone-enough for the
+        64-tick ``anomaly_recent`` window it feeds)."""
         if self.sentinel is None:
             return
+        wall = time.perf_counter() - t_step0
+        depth = float(len(self.queue))
+        tick = self._step_count
+        if self.host_pool is not None:
+            self._tick_obs.append((wall, depth, tick))
+            if len(self._tick_obs) >= self.tick_obs_batch:
+                self.flush_host_work()
+            return
         self._note_anomaly(self.sentinel.observe(
-            "tick_time", time.perf_counter() - t_step0,
-            tick=self._step_count,
+            "tick_time", wall, tick=tick,
         ))
         self._note_anomaly(self.sentinel.observe(
-            "queue_depth", float(len(self.queue)), tick=self._step_count,
+            "queue_depth", depth, tick=tick,
         ))
+
+    def flush_host_work(self) -> None:
+        """Ship the buffered per-tick sentinel observations to a worker
+        as ONE task (in-order within the batch; a hit latches
+        ``_last_anomaly_step`` to its tick — single int store, benign).
+        The router calls this before its pool barrier so the tail of a
+        drain is observed too. No-op without a pool or a buffer."""
+        if self.host_pool is None or not self._tick_obs:
+            return
+        batch, self._tick_obs = self._tick_obs, []
+
+        def work():
+            with self.ledger.host("metrics-refresh", self.replica_id):
+                last_hit = None
+                for wall, depth, tick in batch:
+                    h1 = self.sentinel.observe("tick_time", wall,
+                                               tick=tick)
+                    h2 = self.sentinel.observe("queue_depth", depth,
+                                               tick=tick)
+                    if h1 is not None or h2 is not None:
+                        last_hit = tick
+                if last_hit is not None:
+                    self._last_anomaly_step = last_hit
+
+        self.host_pool.submit(work)
 
     def _log_request(self, req: Request) -> None:
         """One ``kind="request"`` JSONL record per retirement — the raw
-        per-request latencies ``telemetry_report.py`` aggregates."""
+        per-request latencies ``telemetry_report.py`` aggregates. With a
+        ``host_pool`` the serialization+write runs on a worker thread:
+        a retired ``Request`` is never mutated again (it left
+        ``resident`` in the same collect that enqueues this), so the
+        closure captures an effectively-frozen object; the logger and
+        ledger are self-locked."""
         if self.metrics_log is None:
             return
+        if self.host_pool is not None:
+            self.host_pool.submit(lambda: self._emit_request_record(req))
+            return
+        with self.ledger.host("jsonl-emit", self.replica_id):
+            self._log_request_record(req)
+
+    def _emit_request_record(self, req: Request) -> None:
+        # worker-side: the ledger stamps the worker thread's name on
+        # the mark, so classify_bubbles sees offloaded JSONL work as
+        # "jsonl-emit@pdt-host-N", not idle-no-work
         with self.ledger.host("jsonl-emit", self.replica_id):
             self._log_request_record(req)
 
@@ -1137,7 +1379,10 @@ class Scheduler:
         revert to resident before any teardown path may free blocks —
         the allocator would refuse to free a ``swapping-out`` chain
         anyway (loudly), so closing the windows here keeps drains both
-        safe AND quiet."""
+        safe AND quiet. Under the async loop a dispatched tick is
+        collected first — its tokens stash for the next collect, so the
+        drain starts from settled host state without dropping any."""
+        self._collect_pending_tick()
         if self.offload:
             self._finalize_swaps()
         self.draining = True
@@ -1268,6 +1513,60 @@ class Scheduler:
         )
 
     # ---- metrics ----
+
+    def _queue_gate_refresh(self) -> None:
+        """Refresh the gate-metrics snapshot OFF the critical path: the
+        latency-series value lists are copied here on the main thread
+        (cheap pointer copies); the worker does the O(n log n)
+        percentile math and swaps the snapshot in under its lock. A
+        stale refresh overwriting a newer one loses at most one tick of
+        percentile drift — the live overlays in ``gate_metrics`` carry
+        everything the depth-bound SLO rungs actually branch on."""
+        vals = {
+            "ttft": list(self.ttft.values),
+            "queue_wait": list(self.queue_wait.values),
+        }
+        goodput_frac = self.goodput.report()["goodput_frac"]
+
+        def work():
+            with self.ledger.host("metrics-refresh", self.replica_id):
+                snap = {"goodput_frac": goodput_frac}
+                for name, v in vals.items():
+                    for q, val in percentiles(v, qs=(95,)).items():
+                        snap[f"{name}_{q}_s"] = val
+                with self._gate_lock:
+                    self._gate_cache = snap
+
+        self.host_pool.submit(work)
+
+    def gate_metrics(self) -> dict:
+        """The SLO gate's routing view of this replica. Synchronous
+        loop: the full (exact, O(n log n)) ``metrics()``. Async loop:
+        the worker-refreshed percentile snapshot overlaid with LIVE
+        cheap counters — queue depth, occupancy, draining, preemptible,
+        anomaly — so every depth-bound decision the gate makes is
+        byte-identical to what the synchronous loop would decide, and
+        only the wall-clock percentile rungs see (≤ one tick of)
+        staleness."""
+        if self.host_pool is None:
+            return self.metrics()
+        with self._gate_lock:
+            snap = dict(self._gate_cache) if self._gate_cache else {}
+        snap.update(
+            replica_id=self.replica_id,
+            queue_depth=len(self.queue),
+            occupancy=len(self.resident) / self.n_slots,
+            occupancy_mean=(
+                self._occupancy_sum / self._step_count
+                if self._step_count else 0.0
+            ),
+            draining=self.draining,
+            offload=self.offload,
+            preemptible=len(self._victims()),
+            anomaly_recent=self.anomaly_recent,
+        )
+        snap.setdefault("goodput_frac", 1.0)
+        return snap
 
     @property
     def anomaly_recent(self) -> bool:

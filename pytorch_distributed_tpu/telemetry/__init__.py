@@ -108,6 +108,7 @@ from pytorch_distributed_tpu.telemetry.overlap import (
     cause_histogram,
     classify_bubbles,
     device_timeline,
+    fleet_busy_summary,
 )
 from pytorch_distributed_tpu.telemetry.reqtrace import (
     NULL_REQTRACER,
@@ -154,6 +155,7 @@ __all__ = [
     "cause_histogram",
     "classify_bubbles",
     "device_timeline",
+    "fleet_busy_summary",
     "NULL_REQTRACER",
     "SPAN_SCHEMA_VERSION",
     "ReqTracer",

@@ -35,6 +35,7 @@ an admission signal, not just a log line.
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from typing import Dict, Optional
 
@@ -115,6 +116,11 @@ class AnomalySentinel:
         self.source = source
         self._detectors: Dict[str, StreamingDetector] = {}
         self.anomalies = 0
+        # round 16: the async host runtime feeds tick series from its
+        # worker pool, so detector windows and the hit counter mutate
+        # under one lock (the median/MAD math runs inside it too —
+        # observe() must judge and absorb atomically per series)
+        self._lock = threading.Lock()
 
     def detector(self, series: str) -> StreamingDetector:
         det = self._detectors.get(series)
@@ -126,10 +132,11 @@ class AnomalySentinel:
         return det
 
     def observe(self, series: str, value: float, **meta) -> Optional[dict]:
-        hit = self.detector(series).observe(value)
-        if hit is None:
-            return None
-        self.anomalies += 1
+        with self._lock:
+            hit = self.detector(series).observe(value)
+            if hit is None:
+                return None
+            self.anomalies += 1
         hit["series"] = series
         if self.source:
             hit["source"] = self.source

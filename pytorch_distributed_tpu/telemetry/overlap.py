@@ -52,13 +52,39 @@ c_{i-1}), c_i]`` and the gap to the next launch ``[c_i, t0_{i+1}]`` is
 a bubble. Its cause is the overlapping host activity with the largest
 share of the gap:
 
-- another replica's busy slice        → ``other-replica-tick``
+- another replica's dispatch wall     → ``other-replica-tick`` (the
+  host loop serialized behind that replica's tick — what the async
+  refactor removes; a sync launch's wall contains its execution, so
+  the synchronous loop's attribution reads as before)
+- another replica's busy slice beyond its dispatch wall
+                                      → ``shared-device-wait`` (round
+  16: the shared device executing someone else — unavoidable at N
+  replicas per device, gone on real N-device hardware)
 - a ledger host mark (``host(...)``)  → the mark's name, one of
   ``tokenize/detokenize``, ``admission/gate``, ``jsonl-emit``,
-  ``handoff-pump``, ``swap-decision``
+  ``handoff-pump``, ``swap-decision``, ``sampling-prep``,
+  ``metrics-refresh`` — marks recorded on a worker thread (round 16:
+  the async host runtime's ``HostWorkerPool``) carry the thread name
+  and classify as ``<name>@<thread>``, so host work OVERLAPPED onto a
+  worker stops being misattributed to ``idle-no-work``
 - a ``kind="span"`` record whose ``seq`` falls inside the gap's logical
   window (the PR 12 join), mapped through ``_SPAN_CAUSES``
 - nothing                             → ``idle-no-work``
+
+Round 16 (async host runtime): ``launch`` tokens can be **collected** —
+``DispatchLedger.complete(token)`` pins an async launch's completion at
+its lagged materialization site (the dispatch-then-collect loop's
+collect phase) exactly like a fence would, without waiting for the
+lagged window. And because N single-process replicas on a CPU host
+share ONE device, per-replica busy slices measured from dispatch
+windows legitimately overlap each other (a launch waits behind the
+other replica's program INSIDE its dispatch→completion window) —
+summing per-replica busy would double-count the shared device.
+``fleet_busy_summary`` reports the interval-UNION busy fraction next
+to the per-replica ones (the ``gather_ab_backend`` honesty pattern:
+per-replica fractions are scheduling health, the union is true device
+utilization), and ``finalize`` emits it as a ``replica=-1`` summary
+record.
 
 Everything lands as ``kind="overlap"`` JSONL (schema-registered) on the
 caller's ``MetricsLogger``: ``ev="launch"``/``ev="host"`` batched off
@@ -89,6 +115,15 @@ DEVICE_PID_BASE = 1_000_000_000
 
 #: the bubble-cause taxonomy (host marks use these names verbatim)
 CAUSE_OTHER_REPLICA = "other-replica-tick"
+#: round 16: the other replica's program EXECUTING on the shared device
+#: while this replica's gap is open — distinct from other-replica-tick,
+#: which is the other replica's host-side DISPATCH WALL occupying the
+#: loop (the serialization the async refactor removes). On one shared
+#: device a sync launch's dispatch wall contains its execution, so the
+#: sync loop's attribution is unchanged; under async dispatch the walls
+#: collapse to microseconds and the execution time shows up here — the
+#: part that vanishes on real N-device hardware (backend honesty).
+CAUSE_SHARED_DEVICE = "shared-device-wait"
 CAUSE_IDLE = "idle-no-work"
 HOST_CAUSES = (
     "tokenize/detokenize",
@@ -96,6 +131,9 @@ HOST_CAUSES = (
     "jsonl-emit",
     "handoff-pump",
     "swap-decision",
+    "sampling-prep",
+    "metrics-refresh",
+    "tick-collect",
 )
 
 #: span names (round-14 ``kind="span"`` stream) → bubble cause, for gaps
@@ -115,12 +153,18 @@ _SPAN_CAUSES = {
 class _LaunchToken:
     """Yielded by ``DispatchLedger.launch``: the call site sets
     ``handle`` to a (non-donated) output array/pytree inside the with
-    block so the lagged fence has something to block on later."""
+    block so the lagged fence has something to block on later. The
+    ledger fills ``rec``/``entry`` on exit so an async call site can
+    hold the token and pin completion itself at its collect site
+    (``DispatchLedger.complete`` — the round-16 dispatch-then-collect
+    loop)."""
 
-    __slots__ = ("handle",)
+    __slots__ = ("handle", "rec", "entry")
 
     def __init__(self):
         self.handle = None
+        self.rec = None
+        self.entry = None
 
 
 class DispatchLedger:
@@ -206,19 +250,28 @@ class DispatchLedger:
                 rec["done"] = t1
             with self._lock:
                 stream = self._streams.setdefault(replica, [])
-                stream.append([rec, None if sync else token.handle])
+                entry = [rec, None if sync else token.handle]
+                stream.append(entry)
+                token.rec = rec
+                token.entry = entry
                 self._append(rec)
                 # the lagged fence target: exactly one candidate per
                 # launch (indices fence consecutively as the stream
                 # grows), so handles older than the window are already
-                # dropped — the ledger pins at most ``lag`` outputs
+                # dropped — the ledger pins at most ``lag`` outputs.
+                # The handle is taken IN PLACE (entry mutated, not
+                # replaced) so a token's ``entry`` ref stays live and
+                # ``complete`` / the fence can never double-target one
+                # launch.
                 fence_target = None
+                fence_handle = None
                 idx = len(stream) - 1 - self.lag
                 if idx >= 0 and stream[idx][1] is not None:
                     fence_target = stream[idx]
-                    stream[idx] = [fence_target[0], None]
+                    fence_handle = fence_target[1]
+                    fence_target[1] = None
             if fence_target is not None:
-                self._fence(fence_target[0], fence_target[1])
+                self._fence(fence_target[0], fence_handle)
 
     def _fence(self, rec: dict, handle) -> None:
         """Block on a LAGGED launch's handle: returns immediately when
@@ -244,12 +297,52 @@ class DispatchLedger:
                 # completion time (exact, not a bound)
                 rec["done"] = f1
 
+    def complete(self, token) -> None:
+        """Pin an async launch's completion at its collect site (the
+        round-16 dispatch-then-collect loop): blocks on the launch's
+        handle like a lagged fence — by collect time the work is
+        usually done and the wait is a no-op; a wait that actually
+        blocked pins ``done`` exactly. Takes the handle out of the
+        lagged-fence window so one launch is never fenced twice.
+        No-op for sync launches, disabled ledgers, and already-fenced
+        entries."""
+        import jax
+
+        if not self.enabled or token is None or token.rec is None:
+            return
+        with self._lock:
+            handle = token.entry[1] if token.entry is not None else None
+            if handle is not None:
+                token.entry[1] = None
+        if handle is None:
+            return
+        f0 = time.perf_counter()
+        try:
+            jax.block_until_ready(handle)
+        except Exception:
+            with self._lock:
+                self.dead_fences += 1
+            return
+        f1 = time.perf_counter()
+        with self._lock:
+            self.fences += 1
+            token.rec["collected"] = True
+            token.rec["fence_wait_s"] = round(f1 - f0, 9)
+            if f1 - f0 > FENCE_BLOCK_EPS_S:
+                # the device was still running at collect: the wait's
+                # return IS the completion time (exact, not a bound)
+                token.rec["done"] = f1
+
     @contextlib.contextmanager
     def host(self, name: str, replica: int = -1):
         """Mark a host-work interval (tokenize/detokenize,
-        admission/gate, jsonl-emit, handoff-pump, swap-decision) — the
-        attribution targets bubbles resolve to. ``replica=-1`` marks
-        router-level work any replica's gap may land in."""
+        admission/gate, jsonl-emit, handoff-pump, swap-decision,
+        sampling-prep, metrics-refresh) — the attribution targets
+        bubbles resolve to. ``replica=-1`` marks router-level work any
+        replica's gap may land in. Marks recorded off the main thread
+        (the async host runtime's workers) carry the thread name, so
+        ``classify_bubbles`` can attribute overlapped worker work
+        instead of calling it ``idle-no-work``."""
         if not self.enabled:
             yield
             return
@@ -259,12 +352,16 @@ class DispatchLedger:
             yield
         finally:
             t1 = time.perf_counter()
+            rec = {
+                "kind": "overlap", "ev": "host", "replica": replica,
+                "name": name, "t0": t0, "t1": t1, "seq0": seq0,
+            }
+            th = threading.current_thread()
+            if th is not threading.main_thread():
+                rec["thread"] = th.name
             with self._lock:
-                self._append({
-                    "kind": "overlap", "ev": "host", "replica": replica,
-                    "name": name, "t0": t0, "t1": t1,
-                    "seq0": seq0, "seq1": self._claim_locked(),
-                })
+                rec["seq1"] = self._claim_locked()
+                self._append(rec)
 
     def _claim_locked(self) -> int:
         # caller holds self._lock; claim without re-locking
@@ -332,10 +429,29 @@ class DispatchLedger:
                 rec = {"kind": "overlap", "ev": "bubble", **b}
                 self.records.append(rec)
                 out.append(rec)
-            for replica, summary in busy_summary(self.records).items():
+            summaries = busy_summary(self.records)
+            for replica, summary in summaries.items():
                 rec = {
                     "kind": "overlap", "ev": "summary",
                     "replica": replica, **summary,
+                }
+                self.records.append(rec)
+                out.append(rec)
+            if len(summaries) > 1:
+                # shared-device honesty (round 16): the interval-UNION
+                # busy fraction as a replica=-1 summary — per-replica
+                # fractions overlap on a shared device and must not be
+                # summed (module docstring)
+                fleet = fleet_busy_summary(self.records)
+                rec = {
+                    "kind": "overlap", "ev": "summary", "replica": -1,
+                    "union": True,
+                    "launches": sum(s["launches"]
+                                    for s in summaries.values()),
+                    "busy_s": fleet["union_busy_s"],
+                    "span_s": fleet["window_s"],
+                    "window_s": fleet["window_s"],
+                    "busy_frac": fleet["union_busy_frac"],
                 }
                 self.records.append(rec)
                 out.append(rec)
@@ -351,6 +467,13 @@ class DispatchLedger:
                     self.sink.log(**rec)
                 self._unemitted = 0
         return out
+
+    def snapshot(self) -> List[dict]:
+        """A consistent copy of the record list — worker threads append
+        concurrently under the lock, so live readers (the fleet metrics
+        rollup) must not iterate ``records`` bare."""
+        with self._lock:
+            return list(self.records)
 
 
 #: Shared no-op ledger (the NULL_TRACER pattern): call sites thread one
@@ -447,22 +570,80 @@ def classify_bubbles(records: Iterable[dict],
                 continue
             causes: Dict[str, float] = {}
             for s in others:
-                ov = _overlap_s(g0, g1, s["start"], s["end"])
-                if ov > 0:
+                # the other replica's host-side dispatch wall occupying
+                # the loop is SERIALIZATION (other-replica-tick); its
+                # device execution beyond that wall is the shared
+                # device working for someone else (shared-device-wait).
+                # A sync launch's wall contains its execution, so
+                # synchronous-loop attribution is unchanged; an async
+                # launch's wall is thin and the split becomes visible.
+                d = _overlap_s(g0, g1, s.get("t0", 0.0),
+                               s.get("t1", 0.0))
+                b = _overlap_s(g0, g1, s["start"], s["end"])
+                both = max(
+                    0.0,
+                    min(g1, s.get("t1", 0.0), s["end"])
+                    - max(g0, s.get("t0", 0.0), s["start"]),
+                )
+                if d > 0:
+                    causes[CAUSE_OTHER_REPLICA] = (
+                        causes.get(CAUSE_OTHER_REPLICA, 0.0) + d
+                    )
+                if b - both > 0:
+                    causes[CAUSE_SHARED_DEVICE] = (
+                        causes.get(CAUSE_SHARED_DEVICE, 0.0) + b - both
+                    )
+            for h in hosts:
+                ov = _overlap_s(g0, g1, h.get("t0", 0.0), h.get("t1", 0.0))
+                if ov <= 0:
+                    continue
+                h_rep = h.get("replica", -1)
+                if h_rep not in (-1, rep) and not h.get("thread"):
+                    # ANOTHER replica's host work on the shared loop:
+                    # this gap exists because the loop was doing that
+                    # replica's tick — the definition of
+                    # other-replica-tick (worker-thread marks are
+                    # overlapped work, not loop serialization, and keep
+                    # their own @thread cause below)
                     causes[CAUSE_OTHER_REPLICA] = (
                         causes.get(CAUSE_OTHER_REPLICA, 0.0) + ov
                     )
-            for h in hosts:
-                if h.get("replica", -1) not in (-1, rep):
-                    continue
-                ov = _overlap_s(g0, g1, h.get("t0", 0.0), h.get("t1", 0.0))
-                if ov > 0:
+                else:
                     name = h.get("name", "?")
+                    # worker-thread marks (round 16) keep the thread
+                    # name in the cause: "jsonl-emit@pdt-host-0" says
+                    # the gap overlapped OFFLOADED host work — visible
+                    # overlap, not idle-no-work, and distinguishable
+                    # from the same work blocking the main loop
+                    if h.get("thread"):
+                        name = f"{name}@{h['thread']}"
                     causes[name] = causes.get(name, 0.0) + ov
+            # apportioned shares (round 16): the winner-take-all cause
+            # stays (back-compat; the "dominant cause" cell), but each
+            # MEASURED candidate also gets its proportional seconds,
+            # with the uncovered remainder booked as idle-no-work —
+            # under the async loop a gap is typically a MIX (the other
+            # replica's host work + this replica's own collect +
+            # unmarked glue), and assigning the whole gap to whichever
+            # candidate is largest overstated it (the r06 96% reading
+            # was safe only because sync walls covered gaps entirely).
+            shares: Optional[Dict[str, float]] = None
+            if causes:
+                cov = sum(causes.values())
+                scale = min(1.0, gap / cov) if cov > 0 else 0.0
+                shares = {c: round(v * scale, 9)
+                          for c, v in causes.items()}
+                rem = gap - sum(shares.values())
+                if rem > 1e-12:
+                    shares[CAUSE_IDLE] = round(
+                        shares.get(CAUSE_IDLE, 0.0) + rem, 9
+                    )
             if not causes:
                 # the PR 12 join: span records whose logical-clock seq
                 # falls inside the gap's window tell what the host loop
-                # was doing even where no ledger mark ran
+                # was doing even where no ledger mark ran (pseudo
+                # weights — winner only, no shares: a span is an
+                # ordering witness, not a measured duration)
                 s0 = cur.get("seq1") if cur is not None else None
                 s1 = nxt.get("seq0") if nxt is not None else None
                 if s0 is not None and s1 is not None:
@@ -475,14 +656,17 @@ def classify_bubbles(records: Iterable[dict],
                 max(causes.items(), key=lambda kv: kv[1])[0]
                 if causes else CAUSE_IDLE
             )
-            bubbles.append({
+            rec = {
                 "replica": rep, "cause": cause,
                 "gap_s": round(gap, 9), "t0": g0, "t1": g1,
                 "after": cur.get("program") if cur is not None else None,
                 "before": nxt.get("program") if nxt is not None else None,
                 "seq0": cur.get("seq1") if cur is not None else None,
                 "seq1": nxt.get("seq0") if nxt is not None else None,
-            })
+            }
+            if shares is not None:
+                rec["shares"] = shares
+            bubbles.append(rec)
     bubbles.sort(key=lambda b: b["t0"])
     return bubbles
 
@@ -525,6 +709,47 @@ def busy_summary(records: Iterable[dict]) -> Dict[int, dict]:
     return out
 
 
+def fleet_busy_summary(records: Iterable[dict]) -> dict:
+    """Shared-device-honest fleet rollup: the interval UNION of every
+    replica's busy slices over the fleet-wide window, next to the
+    per-replica fractions. On a host where N replicas share one device
+    (the CPU simulation — and any oversubscribed placement), a launch's
+    dispatch→completion window includes time spent queued behind the
+    other replica's program, so per-replica "busy" slices overlap and
+    their SUM double-counts the device. The union is true device
+    utilization; the per-replica fractions are per-stream scheduling
+    health. The ``gather_ab_backend`` pattern: report both, marked.
+
+    Returns ``{"replicas": {rep: busy_frac}, "union_busy_s",
+    "window_s", "union_busy_frac"}`` (zeros when no launches)."""
+    records = list(records)
+    timelines = device_timeline(records)
+    window = _global_window(timelines)
+    per = {rep: s["busy_frac"] for rep, s in busy_summary(records).items()}
+    if window is None:
+        return {"replicas": per, "union_busy_s": 0.0, "window_s": 0.0,
+                "union_busy_frac": 0.0}
+    intervals = sorted(
+        (s["start"], s["end"])
+        for slices in timelines.values() for s in slices
+        if s["end"] > s["start"]
+    )
+    merged: List[List[float]] = []
+    for a, b in intervals:
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    union = sum(b - a for a, b in merged)
+    w = window[1] - window[0]
+    return {
+        "replicas": per,
+        "union_busy_s": round(union, 9),
+        "window_s": round(w, 9),
+        "union_busy_frac": round(union / w, 6) if w > 0 else 0.0,
+    }
+
+
 def busy_within(records: Iterable[dict], replica: int,
                 t0: float, t1: float) -> Tuple[float, float]:
     """``(busy_s, bubble_s)`` of ``replica``'s device inside the wall
@@ -541,15 +766,27 @@ def busy_within(records: Iterable[dict], replica: int,
 def cause_histogram(records: Iterable[dict]) -> Dict[str, dict]:
     """``{cause: {count, gap_s}}`` from ``ev="bubble"`` records (the
     report's histogram; recompute with ``classify_bubbles`` when a
-    stream carries launches but no finalize ran)."""
+    stream carries launches but no finalize ran). Bubbles carrying
+    apportioned ``shares`` (round 16) contribute their measured
+    per-cause seconds; legacy/span-joined bubbles contribute their
+    whole gap to the winning cause. ``count`` counts bubbles a cause
+    appeared in, either way."""
     hist: Dict[str, dict] = {}
     bubbles = overlap_records(records, "bubble")
     if not bubbles:
         bubbles = classify_bubbles(records)
     for b in bubbles:
-        h = hist.setdefault(b.get("cause", "?"), {"count": 0, "gap_s": 0.0})
-        h["count"] += 1
-        h["gap_s"] += b.get("gap_s", 0.0)
+        shares = b.get("shares")
+        if isinstance(shares, dict) and shares:
+            for cause, sec in shares.items():
+                h = hist.setdefault(cause, {"count": 0, "gap_s": 0.0})
+                h["count"] += 1
+                h["gap_s"] += sec
+        else:
+            h = hist.setdefault(b.get("cause", "?"),
+                                {"count": 0, "gap_s": 0.0})
+            h["count"] += 1
+            h["gap_s"] += b.get("gap_s", 0.0)
     for h in hist.values():
         h["gap_s"] = round(h["gap_s"], 9)
     return hist

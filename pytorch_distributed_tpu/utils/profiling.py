@@ -23,6 +23,7 @@ import atexit
 import contextlib
 import json
 import os
+import threading
 import time
 from typing import Iterator, Optional
 
@@ -236,6 +237,12 @@ class MetricsLogger:
     total disk stays bounded by ~2×``max_bytes`` while the newest
     history is always intact. Rotation is record-aligned (checked after
     a complete line), so neither generation ever holds a torn record.
+
+    Thread-safe (round 16): the async host runtime's worker threads
+    emit per-request records concurrently with the main loop, so the
+    serialize+write+rotate sequence holds one lock — records from any
+    thread land as whole lines, and rotation can never interleave with
+    a write.
     """
 
     def __init__(self, path: Optional[str], rank0_only: bool = True,
@@ -244,6 +251,7 @@ class MetricsLogger:
         self.max_bytes = max_bytes
         self.rotations = 0
         self._f = None
+        self._lock = threading.Lock()
         if path and (not rank0_only or self._is_rank0()):
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._f = open(path, "a", buffering=1)
@@ -262,9 +270,14 @@ class MetricsLogger:
         if self._f is None:
             return
         record.setdefault("ts", time.time())
-        self._f.write(json.dumps(record) + "\n")
-        if self.max_bytes is not None and self._f.tell() >= self.max_bytes:
-            self._rotate()
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            if self._f is None:  # closed by another thread
+                return
+            self._f.write(line)
+            if (self.max_bytes is not None
+                    and self._f.tell() >= self.max_bytes):
+                self._rotate()
 
     def _rotate(self) -> None:
         """Roll the full active file to ``<path>.1`` (one kept
@@ -283,8 +296,10 @@ class MetricsLogger:
                 atexit.unregister(self.close)
             except Exception:
                 pass
-            self._f.close()
-            self._f = None
+            with self._lock:
+                if self._f is not None:
+                    self._f.close()
+                    self._f = None
 
     def __enter__(self) -> "MetricsLogger":
         return self

@@ -198,6 +198,12 @@ def _parse() -> argparse.Namespace:
                    help="split replicas into prefill-only and decode "
                         "roles with KV-block handoff (needs --replicas "
                         ">= 2)")
+    p.add_argument("--async-host", action="store_true",
+                   help="round-16 async host runtime: dispatch-then-"
+                        "collect replica ticks (lagged token collect) "
+                        "+ worker threads for JSONL/gate-metric host "
+                        "work; greedy token streams identical to the "
+                        "synchronous loop")
     p.add_argument("--prefill-replicas", type=int, default=1,
                    help="prefill replicas when --disaggregate")
     p.add_argument("--slo-ttft-ms", type=float, default=None,
@@ -292,7 +298,8 @@ def main() -> None:
         else NULL_REQTRACER
     )
     t0 = time.perf_counter()
-    fleet_mode = args.replicas > 1 or args.disaggregate or args.trace
+    fleet_mode = (args.replicas > 1 or args.disaggregate or args.trace
+                  or args.async_host)
     if args.dense and (args.cost_cards or args.metrics_port is not None):
         raise SystemExit("--cost-cards/--metrics-port need the paged "
                          "layout (program registry + scheduler metrics); "
@@ -332,6 +339,7 @@ def main() -> None:
             disaggregate=args.disaggregate,
             n_prefill=args.prefill_replicas, slo=slo, seed=args.seed,
             metrics_log=mlog, tracer=tracer, reqtrace=reqtrace,
+            async_host=args.async_host,
             n_slots=args.slots,
             block_len=args.block_len, prefill_chunk=args.prefill_chunk,
             admit_per_step=args.admit_per_step, n_blocks=args.n_blocks,

@@ -10,7 +10,8 @@
 #                             --telemetry-smoke|--warmup-smoke|--reshard-smoke|
 #                             --fleet-smoke|--obs-smoke|--kernel-smoke|
 #                             --pressure-smoke|--trace-smoke|
-#                             --overlap-smoke|--bench-regression]
+#                             --overlap-smoke|--async-smoke|
+#                             --bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -90,6 +91,17 @@
 # render the overlap section (--require overlap) from the kept JSONL;
 # and explain_request.py must show a decode window's device-busy vs
 # bubble split on a complete trace (~30 s).
+#
+# --async-smoke: lint, then the round-16 async host runtime cycle:
+# a short seeded trace through bench_serving.py --wall-clock (which now
+# A/Bs the synchronous loop against the dispatch-then-collect loop on
+# the same trace) must report the async side's decomposed gap
+# accounting >=90% with the other-replica-tick share of the apportioned
+# bubble histogram below 0.6 (the sync one-loop baseline attributed
+# ~all bubble seconds to it); then explain_request.py
+# --assert-complete must close a span tree from the ASYNC run's JSONL
+# (worker-thread emission must not tear traces) and telemetry_report.py
+# must render both the overlap and spans sections from it (~40 s).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -287,6 +299,43 @@ PY
         | tee "$smoke/explain.txt"
     grep -q "busy /" "$smoke/explain.txt" \
         || { echo "explain output missing the device busy/bubble split"; exit 1; }
+    exit 0
+fi
+
+if [[ "${1:-}" == "--async-smoke" ]]; then
+    echo "== async smoke (sync-vs-async wall-clock A/B -> honest histogram -> traces) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py \
+        --gen-trace "$smoke/trace.jsonl" --trace-duration 30 \
+        --trace-base-rate 0.5 --trace-prompt-max 88
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --wall-clock \
+        --trace "$smoke/trace.jsonl" --wc-out "$smoke/async.jsonl" \
+        > "$smoke/wallclock.json"
+    python - "$smoke/wallclock.json" <<'PY'
+import json, sys
+row = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert row["serving_wallclock_async_tok_s_nr"] > 0, row
+acc = row["serving_wallclock_async_gap_accounted_frac"]
+assert acc >= 0.9, f"async gap accounted only {acc:.0%}"
+share = row["serving_wallclock_async_other_replica_share"]
+# the sync one-loop attributed ~all bubble seconds to the other
+# replica's host work; the async loop's apportioned histogram must
+# keep it below this threshold (at 2 replicas the irreducible
+# shared-loop floor is ~half of the remaining host-bound bubbles)
+assert share < 0.6, f"other-replica-tick still {share:.0%} of bubbles"
+assert "serving_wallclock_async_device_busy_frac_union" in row, sorted(row)
+print(f"async smoke: sync {row['serving_wallclock_tok_s_nr']} tok/s vs "
+      f"async {row['serving_wallclock_async_tok_s_nr']} tok/s "
+      f"(ratio {row['serving_wallclock_ratio_async_over_sync']}), "
+      f"other-replica share {share:.0%}, gap accounted {acc:.0%}, "
+      f"backend={row['serving_wallclock_backend']}")
+PY
+    JAX_PLATFORMS=cpu python scripts/explain_request.py \
+        "$smoke/async.jsonl" --find any --assert-complete > /dev/null
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/async.jsonl" --json --require overlap,spans > /dev/null
+    echo "async smoke OK"
     exit 0
 fi
 

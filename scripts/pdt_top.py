@@ -251,6 +251,15 @@ class View:
         if self.overlap_summary or self.overlap_launches:
             cells = []
             for rep, s in sorted(self.overlap_summary.items()):
+                if rep == -1:
+                    # the round-16 union summary: true device
+                    # utilization when replicas share a device —
+                    # per-replica fractions overlap and must not be
+                    # summed (shared-device honesty)
+                    cells.append(
+                        f"union busy {s.get('busy_frac', 0.0):.0%}"
+                    )
+                    continue
                 top = self._top_cause(rep)
                 cells.append(
                     f"r{rep} busy {s.get('busy_frac', 0.0):.0%}"

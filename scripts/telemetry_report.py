@@ -434,6 +434,7 @@ def overlap_section(records: List[dict], out: dict) -> List[str]:
     from pytorch_distributed_tpu.telemetry.overlap import (
         busy_summary,
         cause_histogram,
+        fleet_busy_summary,
         overlap_records,
     )
 
@@ -451,6 +452,17 @@ def overlap_section(records: List[dict], out: dict) -> List[str]:
             f"{s['busy_frac']:.3f}",
         ))
         out[f"overlap_busy_frac_r{rep}"] = s["busy_frac"]
+    if len(summary) > 1:
+        # shared-device honesty (round 16): per-replica busy windows
+        # overlap on a shared device; the interval union is true device
+        # utilization and must be reported next to them
+        fb = fleet_busy_summary(records)
+        lines.append(_fmt_row(
+            "union", "-", f"{fb['union_busy_s'] * 1e3:.1f}ms",
+            f"{fb['window_s'] * 1e3:.1f}ms",
+            f"{fb['union_busy_frac']:.3f}",
+        ))
+        out["overlap_busy_frac_union"] = fb["union_busy_frac"]
     hist = cause_histogram(records)
     total = sum(h["gap_s"] for h in hist.values())
     if hist:

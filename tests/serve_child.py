@@ -14,8 +14,16 @@ The child streams flight-recorder events to a durable mirror
 preceded the kill, and writes ``result.json`` with every request's token
 stream on a clean finish.
 
+Round 16 (``--fleet-async``): the same seeded workload through a
+2-replica ``FleetRouter(async_host=True)`` — the dispatch-then-collect
+loop with worker threads — so the kill matrix gains an async-loop cell:
+SIGKILL inside a swap window while ticks are in flight and workers hold
+queued JSONL must still leave nothing durable to corrupt, and the
+relaunch must serve token streams identical to the synchronous
+reference.
+
 Not a pytest module (no ``test_`` prefix) — invoke as
-``python tests/serve_child.py --save-dir DIR``.
+``python tests/serve_child.py --save-dir DIR [--fleet-async]``.
 """
 
 import argparse
@@ -48,6 +56,10 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--save-dir", required=True)
     ap.add_argument("--max-new", type=int, default=6)
+    ap.add_argument("--fleet-async", action="store_true",
+                    help="serve through a 2-replica async-host fleet "
+                         "(dispatch-then-collect + worker threads) "
+                         "instead of the single synchronous scheduler")
     args = ap.parse_args()
 
     from pytorch_distributed_tpu.models.transformer import (
@@ -64,22 +76,41 @@ def main() -> int:
     flightrec = FlightRecorder(
         mirror_path=os.path.join(args.save_dir, "flightrec.jsonl")
     )
-    # over-committed on purpose: the pool holds ~3 chains for 4 lanes +
-    # queue, so admission pressure preempts (forced swap path — the
-    # hazard sites under test are the swap's)
-    s = Scheduler(
-        cfg, params, n_slots=4, n_blocks=10, block_len=8,
-        prefill_chunk=16, offload=True, preempt_on_oom=True,
-        swap_policy="swap", protect_ticks=0, flightrec=flightrec,
-    )
-    rids = [s.submit(p, args.max_new) for p in workload(cfg)]
-    streams = s.drain()
-    assert s.metrics()["preempts"] >= 1, "workload never preempted"
+    if args.fleet_async:
+        from pytorch_distributed_tpu.fleet import FleetRouter, SLOConfig
+
+        # same over-commit per replica; the async loop keeps ticks in
+        # flight and worker threads hold queued telemetry when the
+        # fault plan SIGKILLs inside the swap window
+        r = FleetRouter(
+            cfg, params, n_replicas=2, async_host=True,
+            slo=SLOConfig(spill_queue_depth=2, shed_queue_depth=10**6),
+            flightrec=flightrec, n_slots=4, n_blocks=10, block_len=8,
+            prefill_chunk=16, offload=True, preempt_on_oom=True,
+            swap_policy="swap", protect_ticks=0,
+        )
+        rids = [r.submit(p, args.max_new) for p in workload(cfg)]
+        streams = r.drain()
+        m = r.metrics()
+        assert m["preempts"] >= 1, "workload never preempted"
+    else:
+        # over-committed on purpose: the pool holds ~3 chains for 4
+        # lanes + queue, so admission pressure preempts (forced swap
+        # path — the hazard sites under test are the swap's)
+        s = Scheduler(
+            cfg, params, n_slots=4, n_blocks=10, block_len=8,
+            prefill_chunk=16, offload=True, preempt_on_oom=True,
+            swap_policy="swap", protect_ticks=0, flightrec=flightrec,
+        )
+        rids = [s.submit(p, args.max_new) for p in workload(cfg)]
+        streams = s.drain()
+        m = s.metrics()
+        assert m["preempts"] >= 1, "workload never preempted"
     with open(os.path.join(args.save_dir, "result.json"), "w") as f:
         json.dump({
             "streams": {str(rid): streams[rid] for rid in rids},
-            "preempts": s.metrics()["preempts"],
-            "swap_aborts": s.metrics()["swap_aborts"],
+            "preempts": m["preempts"],
+            "swap_aborts": m["swap_aborts"],
         }, f)
     return 0
 

@@ -1,0 +1,501 @@
+"""Async fleet host runtime (round 16 tentpole): dispatch-then-collect
+token identity vs the synchronous loop (plain, disaggregated, and
+pressure fleets), lagged-collect ordering, the early-collect protocol on
+preempt/drain, the worker pool's barrier semantics, worker-thread host
+marks in the bubble classifier, the ledger's collect-site completion,
+the union busy rollup, the no-hot-sync + no_recompile guards with the
+async loop armed, a SIGKILL-mid-swap async-loop kill-matrix cell, and a
+rules_threads-clean gate on every module the refactor touched."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.analysis import no_recompile
+from pytorch_distributed_tpu.analysis.core import LintContext, parse_file
+from pytorch_distributed_tpu.analysis.rules_threads import (
+    check_threads,
+    thread_inventory,
+)
+from pytorch_distributed_tpu.fleet import FleetRouter, SLOConfig
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.resilience import faults
+from pytorch_distributed_tpu.resilience.faults import FaultPlan, FaultSpec
+from pytorch_distributed_tpu.serving import HostWorkerPool, Scheduler
+from pytorch_distributed_tpu.telemetry import (
+    DispatchLedger,
+    ReqTracer,
+    classify_bubbles,
+    fleet_busy_summary,
+    validate_stream,
+)
+from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHED_KW = dict(n_slots=3, block_len=8, prefill_chunk=16,
+                admit_per_step=4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(attention="dense", max_seq_len=64)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def _prompts(cfg, lens=(5, 16, 23, 31, 9, 17), seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, l).astype(np.int32)
+            for l in lens]
+
+
+def _fleet(cfg, params, async_host, **extra):
+    kw = dict(SCHED_KW)
+    kw.update(extra.pop("sched_kw", {}))
+    return FleetRouter(
+        cfg, params, n_replicas=2, async_host=async_host,
+        slo=SLOConfig(spill_queue_depth=2, shed_queue_depth=10**6),
+        **extra, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# token identity: async vs sync, across fleet modes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["plain", "disagg", "pressure"])
+def test_async_sync_token_identity(model, mode):
+    """Bit-identical greedy token streams between the synchronous loop
+    and dispatch-then-collect, on the plain fleet, the disaggregated
+    prefill/decode fleet, and the over-committed pressure fleet (where
+    preempt/restore fires under the async loop too)."""
+    cfg, params = model
+    extra = {}
+    if mode == "disagg":
+        extra = dict(disaggregate=True, decode_slots=4,
+                     handoffs_per_tick=1)
+    elif mode == "pressure":
+        extra = dict(offload=True, preempt_on_oom=True,
+                     swap_policy="swap", protect_ticks=0,
+                     sched_kw=dict(n_blocks=10))
+    results = {}
+    for async_host in (False, True):
+        r = _fleet(cfg, params, async_host, **extra)
+        for i, p in enumerate(_prompts(cfg)):
+            r.submit(p, 5, session=i % 3)
+        results[async_host] = (r.drain(), r)
+    sync_out, _ = results[False]
+    async_out, ra = results[True]
+    assert set(sync_out) == set(async_out)
+    for rid in sync_out:
+        assert sync_out[rid] == async_out[rid], f"stream {rid} diverged"
+    assert not ra.rejected
+    if mode == "pressure":
+        assert ra.metrics()["preempts"] >= 1
+        assert ra.metrics()["restores"] >= 1
+    if mode == "disagg":
+        assert ra.metrics()["handoffs"] == len(sync_out)
+    # every pool block freed, worker pool drained
+    for s in ra.replicas:
+        assert s.engine.allocator.in_use == 0
+        assert not s.has_uncollected
+
+
+def test_async_identity_on_bursty_trace(model):
+    """The smoke-trace identity gate: a seeded bursty trace replayed
+    through both loops at the same per-tick load — same served rid set,
+    same token values."""
+    from pytorch_distributed_tpu.fleet import (
+        clamp_trace,
+        generate_trace,
+        prompt_for,
+        replay_trace,
+    )
+
+    cfg, params = model
+    trace = clamp_trace(
+        generate_trace(seed=5, duration_s=30.0, base_rate=0.6,
+                       sessions=8, prompt_max=48, max_new_max=8),
+        cfg.max_seq_len, SCHED_KW["prefill_chunk"],
+    )
+    outs = {}
+    for async_host in (False, True):
+        r = _fleet(cfg, params, async_host)
+        replay_trace(
+            trace,
+            lambda req: r.submit(prompt_for(req, cfg.vocab_size),
+                                 req.max_new, session=req.session),
+            r.step,
+            lambda: r.idle,
+        )
+        outs[async_host] = dict(r.results)
+    assert outs[False] == outs[True]
+
+
+# ---------------------------------------------------------------------------
+# lagged-collect ordering
+# ---------------------------------------------------------------------------
+
+
+def test_lagged_collect_one_tick_behind(model):
+    """The async loop's contract: ``step()`` N returns the tokens of
+    tick N−1 (collected lagged) while tick N is left in flight — a
+    pending, uncollected ``TickHandle`` exists between steps, and the
+    per-rid stream order is preserved."""
+    cfg, params = model
+    r = _fleet(cfg, params, True)
+    rid = r.submit(np.arange(1, 10, dtype=np.int32), 3)
+    first_out = r.step()
+    # step 1 dispatched tick 1 (admission + first chunk); nothing was
+    # in flight to collect, so no tokens can have been returned yet
+    assert first_out == []
+    seen = []
+    pending_seen = 0
+    for _ in range(16):
+        if any(s._pending_tick is not None for s in r.replicas):
+            pending_seen += 1
+        seen.extend(tok for _rid, tok in r.step())
+        if r.idle:
+            break
+    assert pending_seen > 0, "no tick was ever left in flight"
+    assert r.results[rid] == seen[:len(r.results[rid])]
+    # sync reference: same values
+    ref = _fleet(cfg, params, False)
+    ref.submit(np.arange(1, 10, dtype=np.int32), 3)
+    assert ref.drain()[0] == r.results[rid]
+
+
+def test_early_collect_on_preempt_and_drain(model):
+    """External mutations collect the pending tick first: preempt_lru
+    mid-flight loses no tokens (they stash and deliver at the next
+    collect), and begin_drain starts from settled state."""
+    cfg, params = model
+    r = _fleet(cfg, params, True, offload=True, preempt_on_oom=True,
+               swap_policy="recompute", protect_ticks=0)
+    rids = [r.submit(p, 4) for p in _prompts(cfg, lens=(9, 12, 7))]
+    for _ in range(4):
+        r.step()
+    target = r.replicas[r.placement[rids[0]]]
+    assert target._pending_tick is not None or target._collected == []
+    victim = target.preempt_lru(reason="test")
+    # the early collect drained the in-flight tick before parking
+    assert target._pending_tick is None
+    out = r.drain()
+    assert victim is None or victim in out
+    # token identity with the synchronous reference, preemption included
+    ref = _fleet(cfg, params, False)
+    for p in _prompts(cfg, lens=(9, 12, 7)):
+        ref.submit(p, 4)
+    want = ref.drain()
+    assert out == want
+    # graceful drain under the async loop: settled, zero leaked blocks
+    r2 = _fleet(cfg, params, True)
+    for p in _prompts(cfg, lens=(9, 12, 7)):
+        r2.submit(p, 4)
+    r2.step(); r2.step()
+    sched = r2.replicas[0]
+    sched.begin_drain()
+    assert sched._pending_tick is None
+    produced, requeued = sched.drain_graceful()
+    assert sched.engine.allocator.in_use == 0
+    r2.replicas[1].begin_drain()
+    r2.replicas[1].drain_graceful()
+
+
+# ---------------------------------------------------------------------------
+# worker pool semantics
+# ---------------------------------------------------------------------------
+
+
+def test_host_worker_pool_fifo_flush_and_errors():
+    pool = HostWorkerPool(n_threads=2)
+    done = []
+    lock = threading.Lock()
+    for i in range(32):
+        pool.submit(lambda i=i: (time.sleep(0.001),
+                                 lock.__enter__(), done.append(i),
+                                 lock.__exit__(None, None, None)))
+    pool.flush()
+    assert sorted(done) == list(range(32))
+    assert pool.pending == 0
+
+    def boom():
+        raise ValueError("worker task failed")
+
+    pool.submit(boom)
+    with pytest.raises(RuntimeError, match="host-worker task"):
+        pool.flush()
+    pool.flush()  # errors cleared at the barrier that reported them
+    pool.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        pool.submit(lambda: None)
+    pool.close()  # idempotent
+
+
+def test_worker_offloads_jsonl_and_gate_snapshot(model, tmp_path):
+    """With the async loop armed, per-request JSONL emission rides the
+    worker pool (marks carry thread names), the gate snapshot refresh
+    runs off-thread, and gate_metrics overlays live counters so
+    depth-bound routing state is never stale."""
+    cfg, params = model
+    path = str(tmp_path / "async.jsonl")
+    with MetricsLogger(path) as mlog:
+        reqtrace = ReqTracer(mlog)
+        ledger = DispatchLedger(mlog, seq_source=reqtrace, emit_every=16)
+        r = _fleet(cfg, params, True, metrics_log=mlog,
+                   reqtrace=reqtrace, ledger=ledger)
+        for s in r.replicas:
+            s.gate_refresh_ticks = 1  # force a refresh on every collect
+        for i, p in enumerate(_prompts(cfg)):
+            r.submit(p, 4, session=i % 2)
+        r.drain()
+        r.log_summary()
+        ledger.finalize()
+    records = [json.loads(l) for l in open(path) if l.strip()]
+    assert validate_stream(records) == []
+    reqs = [rec for rec in records if rec.get("kind") == "request"]
+    assert len(reqs) == len(_prompts(cfg))
+    worker_marks = [
+        rec for rec in records
+        if rec.get("kind") == "overlap" and rec.get("ev") == "host"
+        and rec.get("thread", "").startswith("pdt-host")
+    ]
+    assert any(m["name"] == "jsonl-emit" for m in worker_marks)
+    assert any(m["name"] == "metrics-refresh" for m in worker_marks)
+    # gate snapshot landed, and the overlay carries the live counters
+    gm = r.replicas[0].gate_metrics()
+    assert gm["queue_depth"] == 0 and "preemptible" in gm
+    assert "ttft_p95_s" in gm  # the worker-refreshed percentile side
+    # the union summary record (replica=-1) reached the stream
+    unions = [rec for rec in records if rec.get("kind") == "overlap"
+              and rec.get("ev") == "summary" and rec.get("replica") == -1]
+    assert len(unions) == 1 and 0 < unions[0]["busy_frac"] <= 1.0
+
+
+def test_worker_thread_marks_classify_not_idle():
+    """Satellite: a gap overlapped only by a worker-thread host mark
+    attributes to ``<name>@<thread>`` — overlapped host work is visible,
+    not ``idle-no-work`` (and not other-replica serialization)."""
+    recs = [
+        {"kind": "overlap", "ev": "launch", "replica": 0,
+         "program": "decode_tick", "t0": 0.0, "t1": 1.0, "seq0": 0,
+         "seq1": 1, "done": 1.0},
+        {"kind": "overlap", "ev": "launch", "replica": 0,
+         "program": "decode_tick", "t0": 2.0, "t1": 3.0, "seq0": 4,
+         "seq1": 5, "done": 3.0},
+        {"kind": "overlap", "ev": "host", "replica": 0,
+         "name": "jsonl-emit", "thread": "pdt-host-0",
+         "t0": 1.1, "t1": 1.9, "seq0": 2, "seq1": 3},
+    ]
+    bubbles = classify_bubbles(recs)
+    assert len(bubbles) == 1
+    assert bubbles[0]["cause"] == "jsonl-emit@pdt-host-0"
+    # apportioned shares: the worker mark's measured seconds plus the
+    # uncovered remainder as idle
+    shares = bubbles[0]["shares"]
+    assert shares["jsonl-emit@pdt-host-0"] == pytest.approx(0.8)
+    assert shares["idle-no-work"] == pytest.approx(0.2)
+
+
+def test_other_replica_host_marks_count_as_serialization():
+    """A gap overlapped by ANOTHER replica's main-thread host mark is
+    the one loop doing that replica's tick — other-replica-tick."""
+    recs = [
+        {"kind": "overlap", "ev": "launch", "replica": 0,
+         "program": "decode_tick", "t0": 0.0, "t1": 1.0, "seq0": 0,
+         "seq1": 1, "done": 1.0},
+        {"kind": "overlap", "ev": "launch", "replica": 0,
+         "program": "decode_tick", "t0": 2.0, "t1": 3.0, "seq0": 6,
+         "seq1": 7, "done": 3.0},
+        {"kind": "overlap", "ev": "host", "replica": 1,
+         "name": "tick-collect", "t0": 1.0, "t1": 2.0,
+         "seq0": 2, "seq1": 3},
+    ]
+    bubbles = classify_bubbles(recs)
+    assert bubbles[0]["cause"] == "other-replica-tick"
+    assert bubbles[0]["shares"]["other-replica-tick"] == pytest.approx(1.0)
+
+
+def test_shared_device_wait_split():
+    """Round 16: the other replica's EXECUTION beyond its dispatch wall
+    classifies as shared-device-wait, while a sync launch (wall contains
+    execution) still reads other-replica-tick — the backend-honesty
+    split."""
+    recs = [
+        {"kind": "overlap", "ev": "launch", "replica": 0,
+         "program": "decode_tick", "t0": 0.0, "t1": 1.0, "seq0": 0,
+         "seq1": 1, "done": 1.0},
+        {"kind": "overlap", "ev": "launch", "replica": 0,
+         "program": "decode_tick", "t0": 3.0, "t1": 4.0, "seq0": 6,
+         "seq1": 7, "done": 4.0},
+        # an ASYNC launch on replica 1: thin dispatch wall [1.0, 1.1],
+        # execution pinned by a blocking fence to [1.1, 3.0]
+        {"kind": "overlap", "ev": "launch", "replica": 1,
+         "program": "decode_tick", "t0": 1.0, "t1": 1.1, "seq0": 2,
+         "seq1": 3, "done": 3.0},
+    ]
+    bubbles = [b for b in classify_bubbles(recs) if b["replica"] == 0]
+    shares = bubbles[0]["shares"]
+    assert shares["other-replica-tick"] == pytest.approx(0.1, abs=1e-6)
+    assert shares["shared-device-wait"] == pytest.approx(1.9, abs=1e-6)
+
+
+def test_fleet_busy_summary_union():
+    """Overlapping busy slices across replicas merge: the union never
+    double-counts the shared window."""
+    recs = [
+        {"kind": "overlap", "ev": "launch", "replica": 0,
+         "program": "p", "t0": 0.0, "t1": 2.0, "seq0": 0, "seq1": 1,
+         "done": 2.0},
+        {"kind": "overlap", "ev": "launch", "replica": 1,
+         "program": "p", "t0": 1.0, "t1": 3.0, "seq0": 2, "seq1": 3,
+         "done": 3.0},
+    ]
+    fb = fleet_busy_summary(recs)
+    assert fb["union_busy_s"] == pytest.approx(3.0)
+    assert fb["window_s"] == pytest.approx(3.0)
+    assert fb["union_busy_frac"] == pytest.approx(1.0)
+    # per-replica fractions sum past the union (the double-count the
+    # union exists to avoid)
+    assert sum(fb["replicas"].values()) > fb["union_busy_frac"]
+
+
+# ---------------------------------------------------------------------------
+# guards: no hot sync, no recompiles, collect-site completion
+# ---------------------------------------------------------------------------
+
+
+def test_async_loop_no_hot_sync_and_no_recompile(model):
+    """Acceptance: the ledger's no-hot-sync guard and ``no_recompile``
+    stay green with the async loop armed — dispatch-then-collect adds
+    zero program variants and never fences a launch newer than the
+    lag."""
+    cfg, params = model
+    ledger = DispatchLedger(lag=2)
+    r = _fleet(cfg, params, True, ledger=ledger)
+    for i, p in enumerate(_prompts(cfg)):
+        r.submit(p, 4, session=i % 2)
+    for _ in range(6):
+        r.step()
+    for s in r.replicas:
+        s.engine._decode_fn = no_recompile(s.engine._decode(),
+                                           warmup_steps=1)
+    for p in _prompts(cfg, lens=(10, 11), seed=1):
+        r.submit(p, 4)
+    r.drain()
+    for s in r.replicas:
+        stats = s.engine._decode_fn.stats
+        assert stats.recompiles_after_warmup == 0
+    assert ledger.hot_fences == 0
+    assert ledger.dead_fences == 0
+    # async decode launches were pinned at their collect site
+    launches = [rec for rec in ledger.records
+                if rec.get("ev") == "launch"
+                and rec.get("program") == "decode_tick"]
+    assert any(rec.get("collected") or rec.get("fenced")
+               for rec in launches)
+
+
+def test_registry_coverage_with_async_loop(model):
+    cfg, params = model
+    r = _fleet(cfg, params, True)
+    for p in _prompts(cfg):
+        r.submit(p, 3)
+    r.drain()
+    r.assert_registry_covers()
+
+
+# ---------------------------------------------------------------------------
+# kill matrix: SIGKILL mid-swap under the async loop
+# ---------------------------------------------------------------------------
+
+
+def _run_serve_child(save_dir, env_extra=None, timeout=300):
+    env = dict(os.environ)
+    env.pop(faults.ENV_PLAN, None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "serve_child.py"),
+         "--save-dir", str(save_dir), "--fleet-async"],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.crash
+def test_kill_matrix_async_loop_sigkill_mid_swap(tmp_path, model):
+    """The async-loop kill-matrix cell: run 1 (2-replica async fleet,
+    forced swap preemptions, ticks in flight, workers holding queued
+    telemetry) dies by SIGKILL inside the swap-out window; run 2
+    relaunches clean and serves token streams identical to the
+    unpreempted greedy reference."""
+    from tests.serve_child import workload
+    from tests.test_pressure import greedy_streams
+
+    plan = FaultPlan([FaultSpec(site="kv.swap_out_d2h", kind="kill",
+                                at=0)])
+    r1 = _run_serve_child(tmp_path, {faults.ENV_PLAN: plan.to_json()})
+    assert r1.returncode == -signal.SIGKILL, (
+        f"child should die by SIGKILL; rc={r1.returncode}\n"
+        f"stdout:{r1.stdout}\nstderr:{r1.stderr}"
+    )
+    assert not os.path.exists(os.path.join(str(tmp_path), "result.json"))
+    r2 = _run_serve_child(tmp_path)
+    assert r2.returncode == 0, (
+        f"relaunch failed\nstdout:{r2.stdout}\nstderr:{r2.stderr}"
+    )
+    with open(os.path.join(str(tmp_path), "result.json")) as f:
+        result = json.load(f)
+    assert result["preempts"] >= 1 and result["swap_aborts"] == 0
+    cfg, params = model
+    prompts = workload(cfg)
+    want = greedy_streams(cfg, params, prompts, 6)
+    for i in range(len(prompts)):
+        assert result["streams"][str(i)] == want[i], f"stream {i}"
+
+
+# ---------------------------------------------------------------------------
+# lint: every new/worker module rules_threads-clean
+# ---------------------------------------------------------------------------
+
+
+def test_rules_threads_clean_on_async_modules():
+    """Satellite gate: every module the async refactor gave threads or
+    thread-shared state to passes the concurrency lints with zero
+    findings — locks (or documented lock-free protocols) on every
+    shared structure."""
+    ctx = LintContext(modules=[], mesh_axes=set(), axis_constants={})
+    for rel in (
+        "pytorch_distributed_tpu/serving/host_worker.py",
+        "pytorch_distributed_tpu/serving/scheduler.py",
+        "pytorch_distributed_tpu/fleet/router.py",
+        "pytorch_distributed_tpu/telemetry/overlap.py",
+        "pytorch_distributed_tpu/telemetry/anomaly.py",
+        "pytorch_distributed_tpu/utils/profiling.py",
+    ):
+        mod = parse_file(os.path.join(REPO, rel), REPO)
+        findings = check_threads(mod, ctx)
+        assert findings == [], [f.render() for f in findings]
+    inv = thread_inventory(parse_file(
+        os.path.join(REPO, "pytorch_distributed_tpu/serving/host_worker.py"),
+        REPO,
+    ))
+    assert inv["threads"], "the worker pool's threads must be inventoried"
+    assert inv["threads"][0]["kind"] == "self-method"
