@@ -247,6 +247,7 @@ def serving_registry(engine, extra: Iterable = ()) -> ProgramRegistry:
                 # pool quantization changes every program's cache avals,
                 # so artifacts must not be interchangeable across it
                 f"kv_dtype={getattr(engine, 'kv_dtype', None)}",
+                f"prefix_cache={getattr(engine, 'prefix_cache', False)}",
                 *extra,
             ),
         )
@@ -283,6 +284,16 @@ def serving_registry(engine, extra: Iterable = ()) -> ProgramRegistry:
             warm=(lambda execute, n=n_pad:
                   engine.warm_import(n, execute=execute)),
             aot=lambda n=n_pad: engine.warm_import(n, execute=False),
+        ))
+    # copy-on-write block duplication (round 17 prefix sharing; absent
+    # unless the engine was built with prefix_cache=True — same gating
+    # story as handoff/swap). ONE program: a block copy has no chain-
+    # length bucketing, and only the full-cover hit path runs it.
+    if getattr(engine, "prefix_cache", False):
+        reg.add(ProgramSpec(
+            name=engine.BLOCK_COPY_PROGRAM,
+            warm=lambda execute: engine.warm_block_copy(execute=execute),
+            aot=lambda: engine.warm_block_copy(execute=False),
         ))
     # host-offload swap programs (round 13 pressure tier; empty unless
     # the engine was built with swap=True — read from the engine so the

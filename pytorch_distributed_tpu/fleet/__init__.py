@@ -41,6 +41,7 @@ from pytorch_distributed_tpu.fleet.traffic import (
     generate_trace,
     load_trace,
     prompt_for,
+    shared_prefix_prompt_for,
     replay_trace,
     save_trace,
 )
@@ -61,6 +62,7 @@ __all__ = [
     "generate_trace",
     "load_trace",
     "prompt_for",
+    "shared_prefix_prompt_for",
     "replay_trace",
     "save_trace",
 ]

@@ -13,7 +13,11 @@ decision:
 - **spill** to the least-loaded cool replica when the affinity replica
   is hot (queue past ``spill_queue_depth``, or its live TTFT /
   queue-wait p95 past the configured SLO target) — the request trades
-  prefix locality for latency;
+  prefix locality for latency. With the round-17 prefix cache on, that
+  trade has a price tag — a spilled session re-prefills its shared
+  prefix from token zero on a cold replica — so ``prefix_sticky_depth``
+  lets a merely-queue-deep affinity replica keep its sessions a few
+  requests longer before the spill;
 - **queue** on the least-loaded replica when every replica is hot but
   none is past the shed bound — backpressure, not failure;
 - **preempt** (round 13, the KV pressure tier) when every replica is
@@ -71,6 +75,13 @@ class SLOConfig:
     #: bound — the zero-shed mode: pressure degrades to backpressure,
     #: never to rejects, as long as the pressure tier is on)
     pressure_queue_depth: Optional[int] = None
+    #: prefix locality rung (round 17): when the session's affinity
+    #: replica runs a prefix cache and is hot ONLY by queue depth (not
+    #: draining, not an SLO/anomaly breach), stay sticky up to this
+    #: deeper bound instead of spilling — the request's shared prefix is
+    #: resident THERE, and a spill re-prefills it from token zero on a
+    #: cold replica. None = off (spill at spill_queue_depth as before).
+    prefix_sticky_depth: Optional[int] = None
 
     def __post_init__(self):
         if self.spill_queue_depth < 1:
@@ -85,6 +96,14 @@ class SLOConfig:
             raise ValueError(
                 "pressure_queue_depth must be >= shed_queue_depth "
                 f"({self.pressure_queue_depth} < {self.shed_queue_depth})"
+            )
+        if self.prefix_sticky_depth is not None and not (
+            self.spill_queue_depth <= self.prefix_sticky_depth
+            <= self.shed_queue_depth
+        ):
+            raise ValueError(
+                "prefix_sticky_depth must lie in [spill_queue_depth, "
+                f"shed_queue_depth], got {self.prefix_sticky_depth}"
             )
 
 
@@ -155,6 +174,20 @@ class SLOGate:
         hot = {i: self.hot(m) for i, m in metrics.items()}
         if preferred is not None and hot.get(preferred) is None:
             return Decision(ADMIT, preferred, "")
+        # prefix locality rung (round 17): the affinity replica's index
+        # holds this session's prefix — if it is hot ONLY by queue
+        # depth, queue a bit deeper there rather than paying a cold
+        # O(prompt) prefill elsewhere. Never overrides draining or a
+        # live SLO/anomaly breach, and never exceeds the shed bound.
+        if (
+            self.slo.prefix_sticky_depth is not None
+            and preferred is not None
+            and hot.get(preferred) == "queue_depth"
+            and metrics[preferred].get("prefix_cache")
+            and metrics[preferred]["queue_depth"]
+            < self.slo.prefix_sticky_depth
+        ):
+            return Decision(ADMIT, preferred, "prefix-sticky")
         by_load = sorted(metrics, key=lambda i: self._load_key(metrics[i]))
         cool = [i for i in by_load if hot[i] is None]
         if cool:

@@ -9,9 +9,14 @@ collectives are a known jaxlib CPU gap). Requests enter through
 ``submit`` with an optional session id:
 
 - **session affinity**: a session's first request pins it to the
-  replica the SLO gate picks; later requests follow (prefix locality —
-  the seam ROADMAP item 2's radix cache plugs into), unless the gate
-  spills them off a hot replica;
+  replica the SLO gate picks; later requests follow — and with
+  ``prefix_cache=True`` replicas (round 17) this IS the prefix-cache
+  key: a session lands where its shared prefix is resident in the
+  replica-local radix index, so the lookup hits without any cross-
+  replica index. The table is LRU-bounded (``affinity_cap``; evictions
+  counted) and the gate's ``prefix_sticky_depth`` rung keeps sessions
+  on a merely-busy affinity replica a few requests longer before a
+  spill trades their prefix locality for latency;
 - **SLO-aware admission** (``fleet.admission.SLOGate``): admit / spill /
   queue / shed against the live per-replica TTFT/queue-wait percentiles
   and queue depths; sheds are explicit per-request JSONL records with
@@ -54,6 +59,7 @@ them.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -91,6 +97,7 @@ class FleetRouter:
                  seed: int = 0, metrics_log=None, tracer=None,
                  flightrec=None, reqtrace=None, ledger=None,
                  async_host: bool = False, host_threads: int = 2,
+                 affinity_cap: int = 4096,
                  **scheduler_kwargs):
         import jax
 
@@ -188,7 +195,20 @@ class FleetRouter:
             i for i, r in enumerate(self.roles) if r == "decode"
         ]
         self._next_rid = 0
-        self._affinity: Dict[int, int] = {}  # session -> replica
+        # session -> replica, LRU-bounded (round 17 fix: this mapping
+        # grew one entry per session forever — a fleet fed from a
+        # 100k-session trace leaked the table. An OrderedDict capped at
+        # ``affinity_cap`` evicts the least-recently-ROUTED session;
+        # an evicted session that returns simply re-pins wherever the
+        # gate sends it, exactly like a new session. The cap also
+        # bounds the prefix-locality loss: a session idle long enough
+        # to fall off the affinity table has usually had its index
+        # blocks LRU-evicted too.)
+        if affinity_cap < 1:
+            raise ValueError(f"affinity_cap must be >= 1, got {affinity_cap}")
+        self.affinity_cap = affinity_cap
+        self._affinity: "OrderedDict[int, int]" = OrderedDict()
+        self._affinity_evictions = 0
         self.placement: Dict[int, int] = {}  # rid -> current replica
         self.rejected: Dict[int, str] = {}  # rid -> shed reason
         self.results: Dict[int, List[int]] = {}
@@ -219,9 +239,11 @@ class FleetRouter:
         will ever stream for it (the explicit fast-reject contract)."""
         rid = self._next_rid
         self._next_rid += 1
-        preferred = (
-            self._affinity.get(session) if session is not None else None
-        )
+        preferred = None
+        if session is not None:
+            preferred = self._affinity.get(session)
+            if preferred is not None:
+                self._affinity.move_to_end(session)  # LRU touch
         with self.ledger.host("admission/gate"):
             decision = self.gate.route(
                 self._group_metrics(self.entry_group), preferred
@@ -251,6 +273,9 @@ class FleetRouter:
         target = decision.replica
         if session is not None and session not in self._affinity:
             self._affinity[session] = target
+            while len(self._affinity) > self.affinity_cap:
+                self._affinity.popitem(last=False)
+                self._affinity_evictions += 1
         if decision.action == SPILL:
             self._spilled += 1
             self.flightrec.record(
@@ -503,6 +528,30 @@ class FleetRouter:
             "preempt_rate": (
                 sum(m["preempts"] for m in per) / placed if placed else 0.0
             ),
+            # prefix-cache rollup (round 17): fleet-wide hit rate over
+            # per-replica lookups (each admission looks up exactly once
+            # on its replica, so concatenating series is exact), the
+            # sharing/COW/eviction totals, and the affinity table's LRU
+            # accounting (the round-17 unbounded-growth fix)
+            "prefix_lookups": sum(m["prefix_lookups"] for m in per),
+            "prefix_hits": sum(m["prefix_hits"] for m in per),
+            "prefix_hit_rate": (
+                sum(m["prefix_hits"] for m in per)
+                / max(sum(m["prefix_lookups"] for m in per), 1)
+            ),
+            "prefix_covered_tokens": sum(
+                m["prefix_covered_tokens"] for m in per
+            ),
+            "admitted_prefill_tokens": sum(
+                m["admitted_prefill_tokens"] for m in per
+            ),
+            "prefix_cow_copies": sum(m["prefix_cow_copies"] for m in per),
+            "prefix_evictions": sum(m["prefix_evictions"] for m in per),
+            "prefix_shared_blocks": sum(
+                m["prefix_shared_blocks"] for m in per
+            ),
+            "affinity_sessions": len(self._affinity),
+            "affinity_evictions": self._affinity_evictions,
             "recommended_replicas": self.recommend_replicas(),
             "recommended_replicas_peak": self._recommend_peak,
             "async_host": self.async_host,

@@ -126,6 +126,26 @@ def prompt_for(req: TraceRequest, vocab_size: int,
                         dtype=np.int64).astype(np.int32)
 
 
+def shared_prefix_prompt_for(req: TraceRequest, vocab_size: int,
+                             prefix_len: int, seed: int = 0,
+                             n_prefixes: int = 1) -> np.ndarray:
+    """System-prompt-heavy prompts (the round-17 prefix-cache trace):
+    a ``prefix_len``-token SYSTEM PREFIX shared across requests —
+    seeded independently of rids, chosen per ``session % n_prefixes``
+    so multi-tenant shapes (one system prompt per tenant) are one knob
+    away — followed by the request's own ``prompt_for`` tail. Total
+    length is ``prefix_len + req.prompt_len``; callers clamp the trace
+    accordingly."""
+    if prefix_len < 1:
+        raise ValueError(f"prefix_len must be >= 1, got {prefix_len}")
+    pid = req.session % max(n_prefixes, 1)
+    # the 3-int tuple seed cannot collide with prompt_for's (seed, rid)
+    rng = np.random.default_rng((seed, 1_000_003, pid))
+    prefix = rng.integers(1, vocab_size, size=prefix_len,
+                          dtype=np.int64).astype(np.int32)
+    return np.concatenate([prefix, prompt_for(req, vocab_size, seed=seed)])
+
+
 # ---------------------------------------------------------------------------
 # JSONL persistence
 # ---------------------------------------------------------------------------

@@ -46,7 +46,9 @@ from pytorch_distributed_tpu.serving.kv_pool import (
     BlockAllocator,
     HostBlockStore,
     HostChain,
+    PrefixIndex,
     blocks_needed,
+    blocks_needed_suffix,
     init_paged_cache,
     paged_cache_specs,
     pool_block_bytes,
@@ -56,6 +58,7 @@ from pytorch_distributed_tpu.serving.engine import (
     KVExport,
     PagedEngine,
     PendingSwap,
+    PrefixHit,
 )
 from pytorch_distributed_tpu.serving.host_worker import HostWorkerPool
 from pytorch_distributed_tpu.serving.scheduler import (
@@ -73,7 +76,10 @@ __all__ = [
     "BlockAllocator",
     "HostBlockStore",
     "HostChain",
+    "PrefixIndex",
+    "PrefixHit",
     "blocks_needed",
+    "blocks_needed_suffix",
     "init_paged_cache",
     "paged_cache_specs",
     "pool_block_bytes",

@@ -46,7 +46,9 @@ from pytorch_distributed_tpu.serving.kv_pool import (
     BlockAllocator,
     HostBlockStore,
     HostChain,
+    PrefixIndex,
     blocks_needed,
+    blocks_needed_suffix,
     init_paged_cache,
     paged_cache_specs,
 )
@@ -81,6 +83,19 @@ class PendingSwap(NamedTuple):
     chain_len: int
     blocks: object  # cache-shaped pytree, [n_pad, block_len, ...] device
     logits_row: object  # [vocab_size] device
+
+
+class PrefixHit(NamedTuple):
+    """One shared-prefix admission (``PagedEngine.admit_shared``):
+    ``covered`` tokens ride existing pool blocks (prefill starts there),
+    ``shared`` of the chain's blocks are incref'd index blocks, and
+    ``cow`` marks the full-cover path that copy-on-write duplicated the
+    boundary block before re-prefilling the final prompt token."""
+
+    covered: int
+    shared: int
+    cow: bool
+    evicted: int  # index blocks dropped to make room for this admission
 
 
 class KVExport(NamedTuple):
@@ -124,6 +139,8 @@ class PagedEngine:
 
     #: registry name of the shared decode program
     DECODE_PROGRAM = "decode_tick"
+    #: registry name of the copy-on-write block duplication program
+    BLOCK_COPY_PROGRAM = "kv_block_copy"
 
     def __init__(self, config, params, n_slots: int, *,
                  n_blocks: Optional[int] = None, block_len: int = 16,
@@ -131,7 +148,8 @@ class PagedEngine:
                  top_k: Optional[int] = None, mesh=None, device=None,
                  handoff: bool = False, swap: bool = False,
                  gather_impl: Optional[str] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 prefix_cache: bool = False):
         from pytorch_distributed_tpu.models.generate import (
             _validate_sampling,
             _validate_serving_config,
@@ -207,6 +225,18 @@ class PagedEngine:
         self.swap = swap
         self._swap_out_fns: Dict[int, callable] = {}
         self._swap_in_fns: Dict[int, callable] = {}
+        # prefix-sharing tier (round 17): the radix index over full
+        # blocks plus the one compiled copy-on-write program, gated by
+        # ``prefix_cache=`` for the same coverage-guard reason as
+        # handoff/swap — engines that never share predict no
+        # kv_block_copy program.
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix: Optional[PrefixIndex] = (
+            PrefixIndex(block_len, self.allocator) if prefix_cache
+            else None
+        )
+        self._copy_fn = None
+        self._cow_copies = 0
         self._per_block_bytes: Optional[int] = None
         # buckets whose program has EXECUTED at least once (call path hot:
         # the next call pays zero compile/load) — run_chunks/decode and the
@@ -510,6 +540,58 @@ class PagedEngine:
             cache_aval, logits_aval, blocks, idx, slot, row
         ).compile()
 
+    def _block_copy_fn(self):
+        """ONE compiled program duplicating one pool block across every
+        cache leaf — the copy-on-write primitive. ``pool.at[dst].set(
+        pool[src])`` tree-mapped over the cache, so int8 pools copy
+        their fp32 scale siblings in the same program (scales share in
+        lockstep by construction). Donates the cache: in place, no pool
+        copy."""
+        if self._copy_fn is not None:
+            return self._copy_fn
+
+        def body(cache, src, dst):
+            return jax.tree.map(
+                lambda pool: pool.at[dst].set(pool[src]), cache
+            )
+
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from pytorch_distributed_tpu.parallel.mesh import shard_map
+
+            body = shard_map(
+                body, mesh=self.mesh,
+                in_specs=(self._cache_specs, P(), P()),
+                out_specs=self._cache_specs,
+                check_vma=False,
+            )
+        self._copy_fn = jax.jit(body, donate_argnums=(0,))
+        return self._copy_fn
+
+    def _require_prefix(self):
+        if not self.prefix_cache:
+            raise RuntimeError(
+                "this engine was built without prefix_cache=True — its "
+                "registry does not predict the kv_block_copy program "
+                "(prefix-enabled schedulers set it)"
+            )
+
+    def warm_block_copy(self, execute: bool = True):
+        """Compile (and inertly run) the COW block copy: trash block
+        onto itself — a self-copy of the garbage absorber, live state
+        untouched. ``execute=False`` returns the ``Compiled`` (cost-card
+        statics)."""
+        self._require_prefix()
+        fn = self._block_copy_fn()
+        src = jnp.asarray(TRASH_BLOCK, jnp.int32)
+        dst = jnp.asarray(TRASH_BLOCK, jnp.int32)
+        if execute:
+            self.cache = fn(self.cache, src, dst)
+            return None
+        cache_aval, _ = self._cache_logits_avals()
+        return fn.lower(cache_aval, src, dst).compile()
+
     def has_chunk_program(self, k_pad: int, wp: int) -> bool:
         """True when the bucket's call path is hot (executed before)."""
         return (k_pad, wp) in self._hot_chunks
@@ -532,6 +614,8 @@ class PagedEngine:
                   sorted(self._swap_out_fns)]
         names += [self.swap_in_program_name(n) for n in
                   sorted(self._swap_in_fns)]
+        if self._copy_fn is not None:
+            names.append(self.BLOCK_COPY_PROGRAM)
         return names
 
     def _cache_logits_avals(self):
@@ -625,6 +709,21 @@ class PagedEngine:
         detach."""
         self.allocator.on_transition = observer
 
+    def _alloc_evict(self, owner: int, shared: List[int],
+                     n_new: int) -> Optional[List[int]]:
+        """``alloc_mixed`` with the prefix index as the pressure valve:
+        on OOM, evict enough LRU index-only blocks to cover the
+        shortfall and retry ONCE. Dropping cache always precedes the
+        round-13 pressure tier's preemption — only when the index has
+        nothing refcount-1 left does the OOM propagate to the caller's
+        queue/preempt ladder."""
+        chain = self.allocator.alloc_mixed(owner, shared, n_new)
+        if chain is None and self.prefix is not None:
+            short = n_new - self.allocator.available
+            if short > 0 and self.prefix.evict(short) > 0:
+                chain = self.allocator.alloc_mixed(owner, shared, n_new)
+        return chain
+
     def admit(self, slot: int, prompt_len: int, max_new_tokens: int) -> bool:
         """Allocate ``slot``'s block chain and write its table row — the
         O(1)-ish host half of admission (the device half is the chunk
@@ -637,12 +736,125 @@ class PagedEngine:
                 f"{self.table_width} (max_seq_len {self.config.max_seq_len}"
                 f" / block_len {self.block_len})"
             )
-        chain = self.allocator.alloc(slot, need)
+        chain = self._alloc_evict(slot, [], need)
         if chain is None:
             return False
         self.tables[slot] = TRASH_BLOCK
         self.tables[slot, :need] = chain
         return True
+
+    # ---- prefix-sharing admission (round 17; ANALYSIS.md "Prefix
+    # sharing & copy-on-write") ----
+
+    def admit_shared(self, slot: int, tokens,
+                     max_new_tokens: int) -> Optional[PrefixHit]:
+        """Admit through the prefix index: the longest full-block match
+        of ``tokens`` rides shared (incref'd) blocks, only the suffix
+        allocates fresh, and prefill starts at ``covered`` — admission
+        costs O(new tokens), not O(prompt).
+
+        Invariants that keep greedy streams token-identical to the
+        no-sharing engine:
+
+        - at least ONE prompt token always re-prefills, so the final
+          chunk regenerates the slot's logits row exactly as a cold
+          prefill would. On a FULL-cover match that token lives inside
+          the last matched block — the copy-on-write case: the boundary
+          block is duplicated (compiled ``kv_block_copy``) into a fresh
+          block the chain owns exclusively, then position ``L-1`` is
+          rewritten with bit-identical KV.
+        - ``covered`` is capped so the chunk-padded tail
+          (``covered + ceil((L-covered)/chunk)*chunk``) stays within
+          ``max_seq_len`` — the same scatter-safety bound cold
+          admission's padding obeys, so no table slice ever clips a
+          live write.
+
+        Returns the ``PrefixHit`` (``covered == 0`` on a miss — still a
+        valid admission), or None on pool OOM with nothing incref'd —
+        the same deterministic-OOM contract as ``admit``."""
+        self._require_prefix()
+        prompt_len = len(tokens)
+        need0 = self.blocks_for(prompt_len, max_new_tokens)
+        if need0 > self.table_width:
+            raise ValueError(
+                f"request needs {need0} blocks > table width "
+                f"{self.table_width} (max_seq_len {self.config.max_seq_len}"
+                f" / block_len {self.block_len})"
+            )
+        bl, c = self.block_len, self.chunk
+        matched = self.prefix.lookup(tokens)
+        covered = len(matched) * bl
+        cow = False
+        if covered >= prompt_len:
+            # full cover: re-prefill the final token to regenerate the
+            # logits row; with block_len 1 that token IS a whole block
+            # (no COW), otherwise the boundary block is COW-duplicated
+            covered = prompt_len - 1
+            cow = covered % bl != 0
+        # scatter-safety cap: the padded tail must fit max_seq_len
+        while covered > 0 and (
+            covered + -(-(prompt_len - covered) // c) * c
+            > self.config.max_seq_len
+        ):
+            covered = (covered - 1) // bl * bl
+            cow = False
+        if covered <= 0:
+            covered, cow = 0, False
+        n_shared = covered // bl
+        need = blocks_needed_suffix(covered, prompt_len, max_new_tokens,
+                                    bl, c)
+        evicted0 = self.prefix.evictions
+        chain = self._alloc_evict(slot, matched[:n_shared],
+                                  need - n_shared)
+        if chain is None:
+            return None
+        self.tables[slot] = TRASH_BLOCK
+        self.tables[slot, :need] = chain
+        if cow:
+            # duplicate the boundary block BEFORE any write lands in it:
+            # positions [n_shared*bl, L-1) must be readable from a block
+            # this chain owns exclusively
+            with self.ledger.launch(self.ledger_replica,
+                                    self.BLOCK_COPY_PROGRAM):
+                self.cache = self._block_copy_fn()(
+                    self.cache,
+                    jnp.asarray(matched[n_shared], jnp.int32),
+                    jnp.asarray(chain[n_shared], jnp.int32),
+                )
+            self._cow_copies += 1
+        return PrefixHit(
+            covered=covered, shared=n_shared, cow=cow,
+            evicted=self.prefix.evictions - evicted0,
+        )
+
+    def prefix_insert(self, slot: int, tokens, upto: int) -> int:
+        """Index ``slot``'s chain blocks covering ``tokens[:upto]``
+        (floored to FULL blocks — every indexed slot holds real
+        prefill-written KV). Called as prefill crosses block boundaries,
+        so concurrent same-prefix requests hit before the donor even
+        retires. Dedup keeps first-writer blocks; returns newly indexed
+        blocks."""
+        self._require_prefix()
+        return self.prefix.insert(tokens, self.allocator.chain(slot), upto)
+
+    def prefix_metrics(self) -> dict:
+        """Exact sharing counters for ``Scheduler.metrics()`` — index
+        state plus the allocator's shared-block census and the COW
+        count."""
+        out = {
+            "prefix_cache": self.prefix_cache,
+            "prefix_cow_copies": self._cow_copies,
+            "prefix_shared_blocks": self.allocator.shared_blocks,
+            "blocks_fresh_allocated": self.allocator.fresh_allocated,
+            "blocks_shared_reused": self.allocator.shared_reused,
+        }
+        if self.prefix is not None:
+            out.update(self.prefix.metrics())
+        else:
+            out.update(prefix_index_blocks=0, prefix_lookups=0,
+                       prefix_hits=0, prefix_hit_rate=0.0,
+                       prefix_inserts=0, prefix_evictions=0)
+        return out
 
     def release(self, slot: int) -> None:
         """Free the slot's chain and point its table row at the trash
@@ -652,12 +864,18 @@ class PagedEngine:
         self.tables[slot] = TRASH_BLOCK
 
     def release_all(self) -> None:
-        """Free every live chain and reset all tables — the scale-down
-        teardown after a graceful drain (fleet/; by then ``in_use`` is
-        already 0, so this is a belt-and-braces reset, not a leak
-        plug)."""
+        """Free every live chain, drop the prefix index's retained
+        blocks, and reset all tables — the scale-down teardown after a
+        graceful drain (fleet/; by then every CHAIN is already freed,
+        so this is a belt-and-braces reset plus the index teardown, not
+        a leak plug). Order matters: chains first, so an index block a
+        live chain still shared is decref'd exactly once per holder —
+        the drain-with-live-sharers invariant the allocator enforces
+        loudly."""
         for owner in self.allocator.owners():
             self.allocator.free(owner)
+        if self.prefix is not None:
+            self.prefix.clear()
         self.tables[:] = TRASH_BLOCK
 
     # ---- prefill→decode handoff (fleet/ disaggregation) ----
@@ -752,7 +970,7 @@ class PagedEngine:
                 f"cannot import block_len={export.block_len} blocks into "
                 f"a block_len={self.block_len} pool"
             )
-        chain = self.allocator.alloc(slot, export.n_blocks)
+        chain = self._alloc_evict(slot, [], export.n_blocks)
         if chain is None:
             return False
         n_pad = self._chain_bucket(export.n_blocks)
@@ -920,7 +1138,7 @@ class PagedEngine:
                 f"cannot swap block_len={chain.block_len} blocks into "
                 f"a block_len={self.block_len} pool"
             )
-        ids = self.allocator.alloc(slot, chain.n_blocks)
+        ids = self._alloc_evict(slot, [], chain.n_blocks)
         if ids is None:
             return False
         self.allocator.set_state(slot, SWAPPING_IN)

@@ -32,6 +32,24 @@ machine (``resident → swapping-out → host → swapping-in → resident``):
 ``free``/``release_all`` REFUSE to free a chain mid-swap, so a drain or
 teardown racing an in-flight swap is a loud error, never a corrupted
 pool.
+
+Round 17 (prefix sharing; ANALYSIS.md "Prefix sharing & copy-on-write"):
+blocks gain REFCOUNTS and the pool a radix :class:`PrefixIndex`. A full
+immutable block — every slot written with real prompt KV — can be
+referenced by several chains at once (``alloc_mixed`` builds a chain
+from shared blocks plus fresh suffix blocks) and by the index itself
+(one reference per indexed block); ``free`` decrements, and a block
+returns to the free list only at refcount zero. That single rule is
+what pins a shared block through the round-13 state machine: a
+preempted/swapped-out chain's ``free`` can never drag a block another
+resident chain (or the index) still references. Chains only ever WRITE
+forward of their covered prefix, so shared blocks are read-only by
+construction; the one exception — a full-cover hit that must re-prefill
+the final prompt token to regenerate its logits row — first duplicates
+the boundary block via the engine's compiled ``kv_block_copy`` program
+(copy-on-write). int8 pools compose for free: a block id names the same
+row range in the int8 pools AND their fp32 scale siblings, so scale
+blocks share and refcount in lockstep.
 """
 
 from __future__ import annotations
@@ -84,20 +102,40 @@ def blocks_needed(prompt_len: int, max_new_tokens: int, block_len: int,
     in owned blocks, dead until decode overwrites it — same argument as
     the dense layout's right-padding) and the decode frontier
     ``prompt_len + max_new_tokens``."""
-    padded_prefill = math.ceil(prompt_len / chunk) * chunk
-    return math.ceil(max(padded_prefill, prompt_len + max_new_tokens)
+    return blocks_needed_suffix(0, prompt_len, max_new_tokens, block_len,
+                                chunk)
+
+
+def blocks_needed_suffix(covered: int, prompt_len: int,
+                         max_new_tokens: int, block_len: int,
+                         chunk: int) -> int:
+    """``blocks_needed`` generalized to a prefix-cache hit: prefill
+    starts at ``covered`` (a block multiple, or prompt_len-1 on the
+    copy-on-write full-cover path), so the chunk padding extends from
+    THERE — ``covered + ceil((L-covered)/chunk)*chunk`` — not from 0.
+    The whole-chain block count (shared prefix blocks included); the
+    caller allocates ``need - covered // block_len`` fresh ones."""
+    padded_end = covered + math.ceil((prompt_len - covered) / chunk) * chunk
+    return math.ceil(max(padded_end, prompt_len + max_new_tokens)
                      / block_len)
 
 
 class BlockAllocator:
     """Free-list allocator over pool block ids ``1..n_blocks-1`` (0 is
-    the trash block) with per-owner chain tracking.
+    the trash block) with per-owner chain tracking and per-block
+    REFCOUNTS (round 17: prefix sharing).
 
     ``alloc`` is all-or-nothing: it returns the chain or ``None`` with
     the free list untouched — the deterministic OOM signal the scheduler
-    turns into queueing. ``free`` returns a chain LIFO, so the next
-    allocation reuses the most recently freed blocks (asserted in
-    tests/test_paged_serving.py)."""
+    turns into queueing. ``free`` decrements every chained block's
+    refcount and returns only the blocks that hit ZERO, LIFO, so the
+    next allocation reuses the most recently freed blocks (asserted in
+    tests/test_paged_serving.py). ``alloc_mixed`` builds a chain from
+    already-referenced SHARED blocks (each incref'd) plus fresh suffix
+    blocks — the prefix-cache admission; ``incref``/``decref`` are the
+    :class:`PrefixIndex`'s own reference on the blocks it retains.
+    Refcount violations (decref of a dead block == double free) are
+    loud RuntimeErrors, never silent corruption."""
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
@@ -110,6 +148,11 @@ class BlockAllocator:
         # hand out 1, 2, 3, ... (deterministic, test-friendly order).
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))
         self._chains: Dict[int, List[int]] = {}
+        # block id -> refcount; a block is live iff it has an entry.
+        self._refs: Dict[int, int] = {}
+        # exact sharing counters (the bench's pool-blocks-per-request)
+        self.fresh_allocated = 0
+        self.shared_reused = 0
         # owner -> transit state; absent == resident. The swap windows
         # (engine.swap_out_begin → swap_out_finish, swap_in_chain) set
         # and clear these; free()/release_all() refuse mid-swap owners.
@@ -176,28 +219,95 @@ class BlockAllocator:
         return sorted(self._states)
 
     def alloc(self, owner: int, n: int) -> Optional[List[int]]:
-        """Allocate ``n`` blocks for ``owner`` (a slot id). Returns the
-        chain, or ``None`` (state unchanged) when fewer than ``n`` blocks
-        are free."""
+        """Allocate ``n`` fresh blocks for ``owner`` (a slot id). Returns
+        the chain, or ``None`` (state unchanged) when fewer than ``n``
+        blocks are free."""
         if n < 1:
             raise ValueError(f"alloc needs n >= 1, got {n}")
+        return self.alloc_mixed(owner, [], n)
+
+    def alloc_mixed(self, owner: int, shared: List[int],
+                    n_new: int) -> Optional[List[int]]:
+        """Build ``owner``'s chain from ``shared`` already-live blocks
+        (each incref'd — the prefix-cache hit) followed by ``n_new``
+        fresh ones. All-or-nothing: ``None`` with NOTHING incref'd when
+        the free list cannot supply the fresh suffix. Sharing a block
+        that is not currently referenced (evicted index entry, stale id)
+        is a caller bug and raises."""
+        if n_new < 0 or (n_new == 0 and not shared):
+            raise ValueError(
+                f"alloc_mixed needs shared blocks or n_new >= 1, got "
+                f"shared={len(shared)} n_new={n_new}"
+            )
         if owner in self._chains:
             raise ValueError(f"owner {owner} already holds a chain")
-        if len(self._free) < n:
+        if len(self._free) < n_new:
             return None  # deterministic OOM: the caller queues
-        chain = [self._free.pop() for _ in range(n)]
+        for b in shared:
+            if b not in self._refs:
+                raise ValueError(
+                    f"cannot share block {b}: not live (evicted or "
+                    "never allocated)"
+                )
+        for b in shared:
+            self._refs[b] += 1
+        fresh = [self._free.pop() for _ in range(n_new)]
+        for b in fresh:
+            self._refs[b] = 1
+        self.fresh_allocated += n_new
+        self.shared_reused += len(shared)
+        chain = list(shared) + fresh
         self._chains[owner] = chain
-        self._notify("alloc", owner, n_blocks=n, free=len(self._free))
+        self._notify("alloc", owner, n_blocks=len(chain),
+                     shared=len(shared), free=len(self._free))
         return list(chain)
 
+    def ref(self, block: int) -> int:
+        """The block's live refcount (0 = not allocated/indexed)."""
+        return self._refs.get(block, 0)
+
+    def incref(self, block: int) -> None:
+        """Add one reference to a LIVE block — the PrefixIndex's claim
+        on a block it retains past its chain's free."""
+        if block not in self._refs:
+            raise ValueError(f"incref of dead block {block}")
+        self._refs[block] += 1
+
+    def decref(self, block: int) -> bool:
+        """Drop one reference; at zero the block returns to the free
+        list (True). Decref of a dead block is a DOUBLE FREE and raises
+        — the invariant that makes shared-block recycling impossible to
+        get silently wrong."""
+        n = self._refs.get(block)
+        if n is None:
+            raise RuntimeError(
+                f"double free: block {block} has no live references"
+            )
+        if n == 1:
+            del self._refs[block]
+            self._free.append(block)
+            return True
+        self._refs[block] = n - 1
+        return False
+
+    @property
+    def shared_blocks(self) -> int:
+        """Blocks currently referenced more than once (chains and/or
+        the prefix index) — the sharing the capacity A/B measures."""
+        return sum(1 for n in self._refs.values() if n > 1)
+
     def free(self, owner: int) -> None:
-        """Release ``owner``'s chain back to the free list (LIFO reuse).
-        Freeing an owner without a chain is a no-op — retirement paths
-        may race a request that never got blocks. Freeing a chain with
-        an OPEN SWAP WINDOW is refused loudly: the d2h/h2d in flight
-        still reads/writes those blocks, and recycling them would
-        corrupt whichever stream reuses them first (the drain-while-
-        swapping race; tests/test_pressure.py)."""
+        """Decref ``owner``'s chain; blocks reaching refcount zero
+        return to the free list (LIFO reuse). Freeing an owner without
+        a chain is a no-op — retirement paths may race a request that
+        never got blocks. Freeing a chain with an OPEN SWAP WINDOW is
+        refused loudly: the d2h/h2d in flight still reads/writes those
+        blocks, and recycling them would corrupt whichever stream reuses
+        them first (the drain-while-swapping race;
+        tests/test_pressure.py). A block another chain or the prefix
+        index still references SURVIVES this free — the pinning rule
+        that lets a preempted chain leave without dragging shared
+        prefix blocks."""
         state = self._states.get(owner)
         if state is not None:
             raise RuntimeError(
@@ -207,9 +317,9 @@ class BlockAllocator:
             )
         chain = self._chains.pop(owner, None)
         if chain:
-            self._free.extend(reversed(chain))
+            freed = sum(self.decref(b) for b in reversed(chain))
             self._notify("free", owner, n_blocks=len(chain),
-                         free=len(self._free))
+                         freed=freed, free=len(self._free))
 
 
 def init_paged_cache(config, params, n_blocks: int, block_len: int,
@@ -314,6 +424,188 @@ def paged_cache_specs(config, cache):
         lambda leaf, spec: spec if leaf.ndim == 4 else P(*tuple(spec)[:3]),
         cache, specs,
     )
+
+
+# ---------------------------------------------------------------------------
+# prefix index (round 17: radix reuse over the block pool)
+# ---------------------------------------------------------------------------
+
+
+class _PrefixNode:
+    """One full block in the radix tree: ``key`` is the block's token
+    tuple (the edge from its parent), ``block`` the pool block id."""
+
+    __slots__ = ("key", "block", "parent", "children", "last_used")
+
+    def __init__(self, key, block, parent):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children: Dict[tuple, "_PrefixNode"] = {}
+        self.last_used = 0
+
+
+class PrefixIndex:
+    """Radix index over FULL immutable pool blocks, keyed by token-ID
+    paths (PagedAttention's prefix-sharing story, SOSP'23 §4.3 applied
+    block-granular).
+
+    Each node is one block: the edge from its parent is the tuple of
+    ``block_len`` token ids written into it, so a path from the root
+    spells a prefix in whole blocks. ``lookup`` walks a prompt block by
+    block and returns the longest matched chain of block ids —
+    admission increfs those via ``BlockAllocator.alloc_mixed`` and
+    allocates only the suffix. ``insert`` retains blocks as their
+    chains fill past block boundaries (one index reference each, via
+    ``incref``); duplicate paths keep the FIRST block (a second chain
+    prefilling the same prefix keeps exclusive ownership of its own
+    copy, which frees normally at retire). Only full blocks enter:
+    every slot holds real prefill-written KV, so an indexed block is
+    immutable by the chains-write-forward rule.
+
+    Eviction is LRU over refcount-1 LEAVES only: a block a resident
+    chain still shares (ref > 1) is pinned, and an interior node must
+    outlive its descendants (a matched path must be physically complete
+    — attention reads the whole chain). ``evict`` is the pool-pressure
+    valve the engine pulls BEFORE the round-13 pressure tier preempts a
+    live chain: dropping cache is always cheaper than parking a
+    stream."""
+
+    def __init__(self, block_len: int, allocator: BlockAllocator):
+        if block_len < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
+        self.block_len = block_len
+        self.allocator = allocator
+        self._children: Dict[tuple, _PrefixNode] = {}  # root edges
+        self._nodes = 0
+        self._clock = 0
+        # exact counters (Scheduler.metrics / kind="prefix" JSONL)
+        self.lookups = 0
+        self.hits = 0
+        self.inserts = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        """Indexed blocks (== index-held references)."""
+        return self._nodes
+
+    @staticmethod
+    def _key(tokens, start: int, stop: int) -> tuple:
+        return tuple(int(t) for t in tokens[start:stop])
+
+    def lookup(self, tokens) -> List[int]:
+        """Longest full-block prefix of ``tokens`` present in the index
+        — the matched chain of pool block ids, possibly empty. Bumps
+        LRU recency along the matched path."""
+        self._clock += 1
+        self.lookups += 1
+        bl = self.block_len
+        out: List[int] = []
+        children = self._children
+        for i in range(len(tokens) // bl):
+            node = children.get(self._key(tokens, i * bl, (i + 1) * bl))
+            if node is None:
+                break
+            node.last_used = self._clock
+            out.append(node.block)
+            children = node.children
+        if out:
+            self.hits += 1
+        return out
+
+    def insert(self, tokens, chain: List[int], upto: int) -> int:
+        """Retain the full blocks covering ``tokens[:upto]`` (floored to
+        whole blocks) under their token path; ``chain`` maps block index
+        to pool block id. New nodes incref their block (the index's own
+        reference); an existing node keeps its block — dedup, nothing
+        incref'd. Returns the number of newly indexed blocks."""
+        self._clock += 1
+        bl = self.block_len
+        nb = min(upto, len(tokens)) // bl
+        if nb > len(chain):
+            raise ValueError(
+                f"insert upto {upto} needs {nb} blocks but the chain "
+                f"has {len(chain)}"
+            )
+        added = 0
+        children = self._children
+        parent = None
+        for i in range(nb):
+            key = self._key(tokens, i * bl, (i + 1) * bl)
+            node = children.get(key)
+            if node is None:
+                self.allocator.incref(chain[i])
+                node = _PrefixNode(key, chain[i], parent)
+                children[key] = node
+                self._nodes += 1
+                added += 1
+                self.inserts += 1
+            node.last_used = self._clock
+            children = node.children
+            parent = node
+        return added
+
+    def _evictable(self) -> List[_PrefixNode]:
+        out = []
+        stack = list(self._children.values())
+        while stack:
+            node = stack.pop()
+            if not node.children:
+                if self.allocator.ref(node.block) == 1:
+                    out.append(node)
+            else:
+                stack.extend(node.children.values())
+        return out
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` blocks: LRU-oldest refcount-1 leaves first,
+        cascading into parents as they become leaves. Returns blocks
+        actually returned to the free list (0 when everything left is
+        pinned by a live chain or is an interior node)."""
+        freed = 0
+        while freed < n:
+            leaves = self._evictable()
+            if not leaves:
+                break
+            node = min(leaves, key=lambda nd: nd.last_used)
+            self._remove(node)
+            freed += 1
+        return freed
+
+    def _remove(self, node: _PrefixNode) -> None:
+        siblings = (node.parent.children if node.parent is not None
+                    else self._children)
+        del siblings[node.key]
+        self._nodes -= 1
+        self.evictions += 1
+        self.allocator.decref(node.block)
+
+    def clear(self) -> int:
+        """Drop every index reference (teardown / ``release_all``):
+        blocks no chain shares return to the free list. Returns the
+        count dropped."""
+        dropped = 0
+        stack = list(self._children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            self.allocator.decref(node.block)
+            dropped += 1
+        self._children = {}
+        self._nodes = 0
+        return dropped
+
+    def metrics(self) -> dict:
+        return {
+            "prefix_index_blocks": self._nodes,
+            "prefix_lookups": self.lookups,
+            "prefix_hits": self.hits,
+            "prefix_hit_rate": (
+                self.hits / self.lookups if self.lookups else 0.0
+            ),
+            "prefix_inserts": self.inserts,
+            "prefix_evictions": self.evictions,
+        }
 
 
 # ---------------------------------------------------------------------------

@@ -94,6 +94,21 @@ handoff span, and KV chain transitions (alloc/free/swap states)
 annotated through the ``BlockAllocator.on_transition`` adapter.
 ``scripts/explain_request.py`` reconstructs any rid's story from the
 resulting ``kind="span"`` JSONL.
+
+Prefix sharing (round 17; ANALYSIS.md "Prefix sharing & copy-on-write"):
+``prefix_cache=True`` arms the radix index over the block pool —
+admission consults ``PagedEngine.admit_shared`` so a prompt whose
+leading full blocks are already resident allocates only the suffix and
+chunk-prefills only the uncovered tail (admission cost O(new tokens),
+the PagedAttention sharing story), with the full-cover boundary block
+copy-on-write duplicated so the final token's re-prefill regenerates
+the logits row without touching shared state. Chains insert their full
+prompt blocks as prefill crosses block boundaries; retirement decrefs,
+and the index's LRU eviction of refcount-1 blocks is the engine's
+first pool-pressure valve — it fires BEFORE ``preempt_on_oom`` parks a
+live chain. Greedy streams stay token-identical to the no-sharing
+engine (tests/test_prefix.py), and every hit lands a ``kind="prefix"``
+JSONL record.
 """
 
 from __future__ import annotations
@@ -233,7 +248,8 @@ class Scheduler:
                  swap_policy: str = "auto", protect_ticks: int = 2,
                  host_store=None,
                  host_store_max_bytes: Optional[int] = None,
-                 reqtrace=None, ledger=None, host_pool=None):
+                 reqtrace=None, ledger=None, host_pool=None,
+                 prefix_cache: bool = False):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
         from pytorch_distributed_tpu.serving.kv_pool import HostBlockStore
 
@@ -258,7 +274,14 @@ class Scheduler:
             top_k=top_k, mesh=mesh, device=device,
             handoff=(handoff or prefill_only), swap=offload,
             gather_impl=gather_impl, kv_dtype=kv_dtype,
+            prefix_cache=prefix_cache,
         )
+        # ---- prefix-sharing tier (round 17): radix reuse + COW ----
+        self.prefix_cache = prefix_cache
+        self._prefix_covered_tokens = 0
+        # prompt tokens actually chunk-prefilled at admission (prefix
+        # hits subtract their covered prefix) — the A/B's headline
+        self._admitted_prefill_tokens = 0
         # ---- pressure tier (round 13): host offload + preemption ----
         self.offload = offload
         self.preempt_on_oom = preempt_on_oom
@@ -548,7 +571,20 @@ class Scheduler:
             # transition fires inside engine.admit and must resolve to
             # this rid (popped right back on the OOM path)
             self._slot2rid[slot] = req.rid
-            if not self.engine.admit(slot, req.length, req.max_new_tokens):
+            if self.prefix_cache:
+                # shared-prefix admission: the longest indexed full-block
+                # match rides shared blocks and only the uncovered tail
+                # prefills (None = pool OOM, the same queue signal)
+                hit = self.engine.admit_shared(
+                    slot, req.tokens, req.max_new_tokens
+                )
+                admitted_ok = hit is not None
+            else:
+                hit = None
+                admitted_ok = self.engine.admit(
+                    slot, req.length, req.max_new_tokens
+                )
+            if not admitted_ok:
                 self._slot2rid.pop(slot, None)
                 # pool OOM: queue (blocks free as others retire). Under
                 # pressure mode, first preempt one LRU victim — its
@@ -571,6 +607,9 @@ class Scheduler:
             req.slot = slot
             req.admit_step = self._step_count
             req.admit_time = now
+            # prefix hit: prefill resumes AT the covered frontier — only
+            # the uncovered tail runs through the chunk programs
+            req.prefill_done = hit.covered if hit is not None else 0
             self.resident[slot] = req
             self.positions[slot] = 0
             self.remaining[slot] = 0  # decode-armed after the last chunk
@@ -578,6 +617,10 @@ class Scheduler:
             self._adm_latency_steps += self._step_count - req.submit_step
             self._adm_latency_s += now - req.submit_time
             self.queue_wait.observe(now - req.submit_time)
+            self._admitted_prefill_tokens += req.length - req.prefill_done
+            if hit is not None:
+                self._prefix_covered_tokens += hit.covered
+                self._log_prefix(req, hit)
             self.flightrec.record(
                 "admit", rid=req.rid, slot=slot, replica=self.replica_id
             )
@@ -589,7 +632,10 @@ class Scheduler:
                 req.span_queue = 0
                 req.span_prefill = self.reqtrace.begin(
                     req.rid, "prefill", replica=self.replica_id,
-                    slot=slot, chunks=-(-req.length // self.engine.chunk),
+                    slot=slot,
+                    chunks=-(-(req.length - req.prefill_done)
+                             // self.engine.chunk),
+                    prefix_covered=req.prefill_done or None,
                 )
             admitted += 1
 
@@ -922,15 +968,33 @@ class Scheduler:
                         np.asarray(req.generated, np.int32),
                     ])
                 self._slot2rid[slot] = rid
-                if not self.engine.admit(
-                    slot, len(seq), req.max_new_tokens - req.produced
-                ):
+                if self.prefix_cache:
+                    # the restore's re-prefill consults the index too: a
+                    # request whose own prompt blocks are still retained
+                    # re-prefills only its generated tail — recompute
+                    # preemption gets cheaper with the cache on
+                    hit = self.engine.admit_shared(
+                        slot, seq, req.max_new_tokens - req.produced
+                    )
+                    restored_ok = hit is not None
+                else:
+                    hit = None
+                    restored_ok = self.engine.admit(
+                        slot, len(seq), req.max_new_tokens - req.produced
+                    )
+                if not restored_ok:
                     self._slot2rid.pop(slot, None)
                     break  # pool OOM: retry when blocks return
                 del self.parked[rid]
                 req.tokens = seq
                 req.generated = []  # consumed into the prompt
-                req.prefill_done = 0
+                req.prefill_done = hit.covered if hit is not None else 0
+                if hit is not None:
+                    self._prefix_covered_tokens += hit.covered
+                    self._log_prefix(req, hit)
+                self._admitted_prefill_tokens += (
+                    req.length - req.prefill_done
+                )
                 req.slot = slot
                 self.resident[slot] = req
                 self.positions[slot] = 0
@@ -939,7 +1003,9 @@ class Scheduler:
                     req.span_prefill = self.reqtrace.begin(
                         rid, "prefill", replica=self.replica_id,
                         slot=slot, resumed="recompute",
-                        chunks=-(-len(seq) // self.engine.chunk),
+                        chunks=-(-(len(seq) - req.prefill_done)
+                                 // self.engine.chunk),
+                        prefix_covered=req.prefill_done or None,
                     )
             req.protect_until = self._step_count + self.protect_ticks
             self._restores += 1
@@ -1037,6 +1103,18 @@ class Scheduler:
                         cold=cold_bucket or None,
                     )
                 req.prefill_done += self.engine.chunk
+                if self.prefix_cache:
+                    # insert on block-boundary fill: every full PROMPT
+                    # block the chunk just completed becomes index-
+                    # reachable NOW, so a same-prefix request later in
+                    # this very burst hits before this one retires.
+                    # Decode-written blocks stay un-indexed — only
+                    # prefill-computed KV is proven token-stable
+                    # (ANALYSIS.md "Prefix sharing & copy-on-write")
+                    self.engine.prefix_insert(
+                        j.slot, req.tokens,
+                        upto=min(req.prefill_done, req.length),
+                    )
                 if req.prefill_done >= req.length:
                     # prefill complete: arm the decode lane at the
                     # prompt's true frontier — or, on a prefill-only
@@ -1303,6 +1381,21 @@ class Scheduler:
 
         self.host_pool.submit(work)
 
+    def _log_prefix(self, req: Request, hit) -> None:
+        """One ``kind="prefix"`` JSONL record per shared-prefix
+        admission (schema-registered; ``telemetry_report.py`` renders
+        the hit-rate/covered-fraction section from these): what the
+        index covered, how many blocks rode shared, and whether the
+        boundary block was copy-on-write duplicated."""
+        if self.metrics_log is None:
+            return
+        self.metrics_log.log(
+            kind="prefix", rid=req.rid, replica_id=self.replica_id,
+            prompt_len=req.length, covered=hit.covered,
+            shared_blocks=hit.shared, cow=hit.cow,
+            evicted=hit.evicted, session=req.session,
+        )
+
     def _log_request(self, req: Request) -> None:
         """One ``kind="request"`` JSONL record per retirement — the raw
         per-request latencies ``telemetry_report.py`` aggregates. With a
@@ -1564,6 +1657,7 @@ class Scheduler:
             offload=self.offload,
             preemptible=len(self._victims()),
             anomaly_recent=self.anomaly_recent,
+            prefix_cache=self.prefix_cache,
         )
         snap.setdefault("goodput_frac", 1.0)
         return snap
@@ -1650,6 +1744,12 @@ class Scheduler:
             "host_store_bytes": (
                 self.host_store.bytes_used if self.offload else 0
             ),
+            # prefix-sharing tier (round 17): index hit rate, sharing
+            # census, COW count, and the admitted-prefill-token sum the
+            # --prefix A/B divides by requests (exact, host-side)
+            **self.engine.prefix_metrics(),
+            "prefix_covered_tokens": self._prefix_covered_tokens,
+            "admitted_prefill_tokens": self._admitted_prefill_tokens,
             **self.swap_lat.summary("swap"),
             # anomaly sentinel (telemetry/anomaly.py): total hits and the
             # recency flag the fleet SLOGate treats as hot

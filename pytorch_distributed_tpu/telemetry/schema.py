@@ -39,6 +39,11 @@ REQUIRED_KEYS: Dict[str, FrozenSet[str]] = {
     ),
     # serving/scheduler.py swap-out/in outcomes
     "swap": frozenset({"rid", "replica_id", "direction", "ok"}),
+    # serving/scheduler.py shared-prefix admissions (round 17)
+    "prefix": frozenset(
+        {"rid", "replica_id", "prompt_len", "covered", "shared_blocks",
+         "cow"}
+    ),
     # telemetry/reqtrace.py lifecycle spans (round 14)
     "span": frozenset({"v", "ev", "trace", "span", "seq", "t"}),
     # telemetry/overlap.py dispatch ledger (round 15); per-``ev`` shapes

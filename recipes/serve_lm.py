@@ -150,6 +150,11 @@ def _parse() -> argparse.Namespace:
     p.add_argument("--kv-dtype", choices=("int8",), default=None,
                    help="quantize the KV block pool (int8 + per-row "
                         "scales, ~2x blocks at fixed pool bytes)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="round-17 prefix-sharing KV cache: radix reuse "
+                        "of full prompt blocks with copy-on-write — a "
+                        "shared-system-prompt request admits in O(new "
+                        "tokens); greedy streams stay token-identical")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dense", action="store_true",
                    help="run the r4 dense layout instead (A/B reference)")
@@ -344,6 +349,7 @@ def main() -> None:
             block_len=args.block_len, prefill_chunk=args.prefill_chunk,
             admit_per_step=args.admit_per_step, n_blocks=args.n_blocks,
             gather_impl=args.gather_impl, kv_dtype=args.kv_dtype,
+            prefix_cache=args.prefix_cache,
             **pressure_kw,
         )
         if args.warmup:
@@ -391,9 +397,9 @@ def main() -> None:
             raise SystemExit("--warmup needs the paged layout (the dense "
                              "ContinuousBatcher has no program registry); "
                              "drop --dense")
-        if args.gather_impl or args.kv_dtype:
-            raise SystemExit("--gather-impl/--kv-dtype are block-pool "
-                             "knobs; drop --dense")
+        if args.gather_impl or args.kv_dtype or args.prefix_cache:
+            raise SystemExit("--gather-impl/--kv-dtype/--prefix-cache are "
+                             "block-pool knobs; drop --dense")
         if args.preempt or args.n_blocks is not None:
             raise SystemExit("--preempt/--n-blocks are block-pool knobs "
                              "(the pressure tier swaps BLOCKS); drop "
@@ -424,6 +430,7 @@ def main() -> None:
             gather_impl=args.gather_impl, kv_dtype=args.kv_dtype,
             offload=args.preempt, preempt_on_oom=args.preempt,
             swap_policy=args.swap_policy,
+            prefix_cache=args.prefix_cache,
         )
         if args.warmup:
             # everything foreground + executed inert: the serve loop below

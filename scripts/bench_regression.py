@@ -50,6 +50,11 @@ BAND_OVERRIDES: Tuple[Tuple[str, float], ...] = (
     # wins.
     (r"device_busy_frac", 0.5),
     (r"gap_accounted_frac", 0.10),
+    # prefix-cache keys (round 17): token accounting is deterministic
+    # per trace but the ratio moves with trace mix; hit rate is bounded
+    # in [0, 1] like the busy fractions above
+    (r"serving_prefix_hit_rate", 0.25),
+    (r"^serving_prefix_", 0.5),
     # the wall-clock fleet bench (round 15) measures MACHINE wall on a
     # shared box — the same weather class as the disk keys; its CPU
     # magnitudes are additionally backend-marked as not-a-claim
@@ -64,11 +69,15 @@ BAND_OVERRIDES: Tuple[Tuple[str, float], ...] = (
     (r"wall_s$", 0.5),
 )
 
-#: keys that are configuration, not measurement
+#: keys that are configuration, not measurement — plus the same-run
+#: link probes (ADVICE §6): they exist to EXPLAIN cross-day swings
+#: (environment weather co-quoted with every serving row), so gating
+#: them would page on the weather itself
 SKIP_PATTERNS = (
     r"batch_size$", r"^platform$", r"^device$", r"^unit$", r"^metric$",
     r"_mode$", r"^host_cores$", r"params_m$", r"bytes_mb$", r"_len$",
     r"slots$", r"_lens$", r"tokens$", r"_frac$", r"vs_baseline",
+    r"^probe_",
 )
 
 _HIGHER_BETTER = re.compile(
@@ -94,6 +103,14 @@ def band_for(key: str, overrides: Dict[str, float]) -> float:
 DIRECTION_OVERRIDES: Tuple[Tuple[str, str], ...] = (
     (r"device_busy_frac", "up"),
     (r"gap_accounted_frac", "up"),
+    # prefix-cache keys (round 17): hit rate and the off/on token ratio
+    # regress DOWN (less sharing); admitted tokens and fresh blocks per
+    # request regress UP (sharing doing less work per request is the
+    # whole point)
+    (r"serving_prefix_hit_rate", "up"),
+    (r"serving_prefix_admit_tok_ratio", "up"),
+    (r"serving_prefix_admit_tok_per_req", "down"),
+    (r"serving_prefix_fresh_blocks_per_req", "down"),
 )
 
 

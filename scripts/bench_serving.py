@@ -809,6 +809,147 @@ def measure_pressure(trace=None, slots: int = 4, n_blocks: int = 13,
     }
 
 
+def measure_prefix(trace=None, slots: int = 8, prefix_len: int = 64,
+                   replicas: int = 2, out_path: str = None) -> dict:
+    """The prefix-sharing A/B (ISSUE 15): ONE seeded shared-system-
+    prompt trace — every request is a ``prefix_len``-token shared
+    system prefix plus its own heavy-tail tail
+    (``fleet.shared_prefix_prompt_for``) — served by the same 2-replica
+    session-affinity fleet with the radix prefix cache OFF and ON.
+
+    Headline: **admitted-prefill tokens per request** (the prompt
+    tokens the chunk programs actually process at admission — a hit
+    skips its covered prefix; the acceptance gate wants >= 2x lower
+    with sharing on) plus admission latency, fresh pool blocks
+    allocated per request, hit rate, COW copies, and a token-identity
+    check (greedy streams must be bit-equal across the A/B, prefix off
+    vs on). Wall-millisecond magnitudes are backend-marked
+    (``gather_ab_backend`` convention): on the CPU simulation they
+    describe host scheduling, not TPU serving."""
+    import dataclasses as _dc
+    import tempfile
+
+    from pytorch_distributed_tpu.fleet import (
+        FleetRouter,
+        SLOConfig,
+        generate_trace,
+        replay_trace,
+        shared_prefix_prompt_for,
+    )
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    cfg, params = _tiny_model()
+    if trace is None:
+        trace = generate_trace(
+            seed=0, duration_s=120.0, base_rate=0.5,
+            burst_rate_mult=4.0, burst_every_s=30.0, burst_len_s=4.0,
+            sessions=8,
+            prompt_median=12, prompt_sigma=0.8, prompt_min=4,
+            prompt_max=32, max_new_median=6, max_new_sigma=0.6,
+            max_new_min=2, max_new_max=12,
+        )
+    # fit prefix + tail + decode budget into the config (the shared
+    # prefix rides on TOP of the trace's prompt_len)
+    tail_max = max(4, (cfg.max_seq_len - prefix_len) // 3)
+    new_max = max(2, (cfg.max_seq_len - prefix_len) // 8)
+    trace = [
+        _dc.replace(r, prompt_len=min(r.prompt_len, tail_max),
+                    max_new=min(r.max_new, new_max))
+        for r in trace
+    ]
+    slo = SLOConfig(spill_queue_depth=4, shed_queue_depth=64,
+                    prefix_sticky_depth=8)
+
+    def run(prefix_on, path):
+        mlog = MetricsLogger(path)
+        router = FleetRouter(
+            cfg, params, n_replicas=replicas, slo=slo, seed=0,
+            metrics_log=mlog, n_slots=slots, block_len=16,
+            prefill_chunk=32, admit_per_step=4,
+            prefix_cache=prefix_on,
+        )
+        router.warmup()
+        t0 = time.perf_counter()
+        ticks = replay_trace(
+            trace,
+            lambda r: router.submit(
+                shared_prefix_prompt_for(r, cfg.vocab_size, prefix_len),
+                r.max_new, session=r.session,
+            ),
+            router.step,
+            lambda: router.idle,
+        )
+        wall = time.perf_counter() - t0
+        m = router.metrics()
+        router.log_summary()
+        # exact admission latency across the fleet (weighted by each
+        # replica's admissions, steps and wall both)
+        per = [s.metrics() for s in router.replicas]
+        admitted = sum(p["admitted"] for p in per) or 1
+        adm_steps = sum(
+            p["admission_latency_steps_mean"] * p["admitted"] for p in per
+        ) / admitted
+        adm_s = sum(
+            p["admission_latency_s_mean"] * p["admitted"] for p in per
+        ) / admitted
+        fresh = sum(
+            s.engine.allocator.fresh_allocated for s in router.replicas
+        )
+        m["admitted"] = sum(p["admitted"] for p in per)
+        mlog.close()
+        return router, m, ticks, wall, adm_steps, adm_s, fresh
+
+    with tempfile.NamedTemporaryFile(suffix=".jsonl") as tf:
+        r_off, m_off, _, wall_off, st_off, s_off, fresh_off = run(
+            False, tf.name
+        )
+    r_on, m_on, _, wall_on, st_on, s_on, fresh_on = run(
+        True, out_path if out_path else None
+    )
+    reqs = max(m_on["completed"], 1)
+    tok_on = m_on["admitted_prefill_tokens"] / max(m_on["admitted"], 1)
+    tok_off = m_off["admitted_prefill_tokens"] / max(m_off["admitted"], 1)
+    identical = r_on.results == r_off.results
+    return {
+        "serving_prefix_trace_requests": len(trace),
+        "serving_prefix_prefix_len": prefix_len,
+        "serving_prefix_replicas": replicas,
+        "serving_prefix_hit_rate": round(m_on["prefix_hit_rate"], 4),
+        "serving_prefix_covered_frac": round(
+            m_on["prefix_covered_tokens"]
+            / max(m_on["prefix_covered_tokens"]
+                  + m_on["admitted_prefill_tokens"], 1), 4
+        ),
+        "serving_prefix_admit_tok_per_req_on": round(tok_on, 2),
+        "serving_prefix_admit_tok_per_req_off": round(tok_off, 2),
+        "serving_prefix_admit_tok_ratio_off_over_on": round(
+            tok_off / max(tok_on, 1e-9), 2
+        ),
+        "serving_prefix_fresh_blocks_per_req_on": round(
+            fresh_on / max(m_on["admitted"], 1), 2
+        ),
+        "serving_prefix_fresh_blocks_per_req_off": round(
+            fresh_off / max(m_off["admitted"], 1), 2
+        ),
+        "serving_prefix_admission_steps_mean_on": round(st_on, 2),
+        "serving_prefix_admission_steps_mean_off": round(st_off, 2),
+        "serving_prefix_admission_ms_mean_on": round(s_on * 1e3, 3),
+        "serving_prefix_admission_ms_mean_off": round(s_off * 1e3, 3),
+        "serving_prefix_cow_copies": m_on["prefix_cow_copies"],
+        "serving_prefix_evictions": m_on["prefix_evictions"],
+        "serving_prefix_shared_blocks_peak": m_on["prefix_shared_blocks"],
+        "serving_prefix_completed": reqs,
+        "serving_prefix_tokens_identical": identical,
+        "serving_prefix_wall_s_on": round(wall_on, 2),
+        "serving_prefix_wall_s_off": round(wall_off, 2),
+        # CPU-honesty label (gather_ab_backend convention, PR 10): the
+        # token-accounting claims hold anywhere; the wall/ms magnitudes
+        # are TPU claims only when this says tpu
+        "serving_prefix_backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+    }
+
+
 # ---------------------------------------------------------------------------
 # wall-clock fleet bench (round 15): the overlap profiler's headline —
 # the measurement contract ROADMAP item 3's async host refactor gates on
@@ -1242,6 +1383,15 @@ def main() -> None:
                 int(x) for x in extra.split(",") if x.strip()
             ),
             reps=_argval("--wc-reps", 1, int),
+        ), **probe}))
+        return
+    if "--prefix" in sys.argv:
+        print(json.dumps({**measure_prefix(
+            trace=_cli_trace(),
+            slots=_argval("--prefix-slots", 8, int),
+            prefix_len=_argval("--prefix-len", 64, int),
+            replicas=_argval("--prefix-replicas", 2, int),
+            out_path=_argval("--prefix-out", None, str),
         ), **probe}))
         return
     if "--pressure" in sys.argv:

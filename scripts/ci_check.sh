@@ -11,7 +11,7 @@
 #                             --fleet-smoke|--obs-smoke|--kernel-smoke|
 #                             --pressure-smoke|--trace-smoke|
 #                             --overlap-smoke|--async-smoke|
-#                             --bench-regression]
+#                             --prefix-smoke|--bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -102,6 +102,15 @@
 # --assert-complete must close a span tree from the ASYNC run's JSONL
 # (worker-thread emission must not tear traces) and telemetry_report.py
 # must render both the overlap and spans sections from it (~40 s).
+#
+# --prefix-smoke: lint, then the round-17 prefix-sharing cycle: one
+# short seeded shared-system-prompt trace through the 2-replica
+# session-affinity fleet with the radix prefix cache OFF then ON
+# (bench_serving.py --prefix) must report hit rate > 0, a >= 1.5x
+# admitted-prefill-token reduction, and BIT-IDENTICAL greedy token
+# streams across the A/B; then telemetry_report.py must render the
+# prefix section (--require prefix: hit rate, covered fraction, COW
+# count) from the ON run's JSONL alone (~40 s).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -336,6 +345,37 @@ PY
     JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
         "$smoke/async.jsonl" --json --require overlap,spans > /dev/null
     echo "async smoke OK"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--prefix-smoke" ]]; then
+    echo "== prefix smoke (shared-prompt trace -> radix reuse A/B -> report) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py \
+        --gen-trace "$smoke/trace.jsonl" --trace-duration 30 \
+        --trace-base-rate 0.5 --trace-sessions 8 \
+        --trace-prompt-median 12 --trace-prompt-max 32 \
+        --trace-max-new-median 6 --trace-max-new-max 12
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --prefix \
+        --trace "$smoke/trace.jsonl" --prefix-out "$smoke/prefix.jsonl" \
+        > "$smoke/prefix.json"
+    python - "$smoke/prefix.json" <<'PY'
+import json, sys
+row = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert row["serving_prefix_hit_rate"] > 0, row
+assert row["serving_prefix_tokens_identical"] is True, \
+    "prefix sharing changed a greedy token stream"
+ratio = row["serving_prefix_admit_tok_ratio_off_over_on"]
+assert ratio >= 1.5, f"admitted-prefill tokens only {ratio}x lower"
+print(f"prefix: hit rate {row['serving_prefix_hit_rate']:.0%}, "
+      f"admitted-prefill tokens {ratio}x lower, "
+      f"{row['serving_prefix_cow_copies']} cow copies, tokens identical "
+      f"(backend={row['serving_prefix_backend']})")
+PY
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/prefix.jsonl" --json --require prefix > /dev/null
+    echo "prefix smoke OK"
     exit 0
 fi
 

@@ -100,6 +100,14 @@ class View:
         self.preempt_decisions: Dict[str, int] = {}
         self.swap_bytes = 0
         self.swap_aborts = 0
+        # prefix cache (round 17; kind="prefix" per-admission records):
+        # lifetime totals plus a tail window for the live hit rate
+        self.prefix_admissions = 0
+        self.prefix_hits = 0
+        self.prefix_covered = 0
+        self.prefix_prompt = 0
+        self.prefix_cows = 0
+        self.recent_prefix: List[dict] = []
         # request-lifecycle spans (kind="span"): open span set and open
         # ROOTS — the live in-flight-requests gauge
         self.open_spans: set = set()
@@ -142,6 +150,17 @@ class View:
                     self.swap_bytes += r.get("bytes", 0)
                 else:
                     self.swap_aborts += 1
+            elif kind == "prefix":
+                self.prefix_admissions += 1
+                if r.get("covered", 0) > 0:
+                    self.prefix_hits += 1
+                self.prefix_covered += r.get("covered", 0)
+                self.prefix_prompt += r.get("prompt_len", 0)
+                if r.get("cow"):
+                    self.prefix_cows += 1
+                self.recent_prefix.append(r)
+                if len(self.recent_prefix) > self.window:
+                    self.recent_prefix.pop(0)
             elif kind == "overlap":
                 ev = r.get("ev")
                 if ev == "launch":
@@ -247,6 +266,18 @@ class View:
                     f"{k}={v}" for k, v in
                     sorted(self.preempt_decisions.items())) + "]"
                    if self.preempt_decisions else "")
+            )
+        if self.prefix_admissions:
+            recent_hits = sum(
+                1 for r in self.recent_prefix if r.get("covered", 0) > 0
+            )
+            out.append(
+                f"prefix   {self.prefix_admissions} admissions, "
+                f"hit {self.prefix_hits / self.prefix_admissions:.1%}"
+                f" (recent {recent_hits}/{len(self.recent_prefix)})  "
+                f"covered {self.prefix_covered}/{self.prefix_prompt} tok "
+                f"({self.prefix_covered / max(self.prefix_prompt, 1):.0%})"
+                + (f"  cow={self.prefix_cows}" if self.prefix_cows else "")
             )
         if self.overlap_summary or self.overlap_launches:
             cells = []

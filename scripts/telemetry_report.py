@@ -31,6 +31,10 @@ produces, from the JSONL alone:
   preempt rate, per-direction swap p50/p95 and bytes moved, swap-vs-
   recompute decision counts and the predicted-cost crossover histogram,
   from ``kind="preempt"``/``kind="swap"`` records;
+- the **prefix section** (round 17; prefix-sharing KV cache) — hit
+  rate, covered-prefix fraction, shared-blocks-per-hit percentiles,
+  COW copies and admission-path evictions, from ``kind="prefix"``
+  per-admission records plus the fleet rollup;
 - the **overlap section** (round 15; ``telemetry/overlap.py``) —
   per-replica device-busy fraction, the bubble-cause histogram
   (other-replica-tick / tokenize / admission / JSONL / handoff / swap /
@@ -425,6 +429,57 @@ def pressure_section(records: List[dict], out: dict) -> List[str]:
     return lines
 
 
+def prefix_section(records: List[dict], out: dict) -> List[str]:
+    """Prefix cache (round 17; ``serving/`` radix reuse + COW): hit
+    rate, covered-prefix fraction, sharing/COW/eviction totals, from
+    ``kind="prefix"`` per-admission records plus the fleet/serving
+    summary rollups."""
+    recs = [r for r in records if r.get("kind") == "prefix"]
+    if not recs:
+        return []
+    lines = ["== prefix cache =="]
+    hits = [r for r in recs if r.get("covered", 0) > 0]
+    covered = sum(r.get("covered", 0) for r in recs)
+    prompt = sum(r.get("prompt_len", 0) for r in recs)
+    cows = sum(1 for r in recs if r.get("cow"))
+    evicted = sum(r.get("evicted", 0) for r in recs)
+    lines.append(
+        f"  {len(recs)} prefix admissions, {len(hits)} hits "
+        f"({len(hits) / len(recs):.1%}); covered {covered} of "
+        f"{prompt} prompt tokens ({covered / max(prompt, 1):.1%})"
+    )
+    lines.append(
+        f"  cow copies: {cows}; admission-path evictions: {evicted}"
+    )
+    shared = [r.get("shared_blocks", 0) for r in hits]
+    if shared:
+        ps = percentiles([float(s) for s in shared], qs=(50, 95))
+        lines.append(_fmt_row(
+            "shared blocks/hit", f"p50 {ps['p50']:.0f}",
+            f"p95 {ps['p95']:.0f}",
+        ))
+    # the fleet rollup, when present, carries the allocator's census
+    fleets = [r for r in records if r.get("kind") == "fleet_summary"
+              and "prefix_hits" in r]
+    if fleets:
+        f = fleets[-1]
+        lines.append(
+            f"  fleet: hit rate {f.get('prefix_hit_rate', 0.0):.1%}, "
+            f"evictions {f.get('prefix_evictions', 0)}, "
+            f"shared blocks now {f.get('prefix_shared_blocks', 0)}, "
+            f"affinity sessions {f.get('affinity_sessions', 0)} "
+            f"(evicted {f.get('affinity_evictions', 0)})"
+        )
+    out["prefix_admissions"] = len(recs)
+    out["prefix_hits"] = len(hits)
+    out["prefix_hit_rate"] = round(len(hits) / len(recs), 4)
+    out["prefix_covered_tokens"] = covered
+    out["prefix_covered_frac"] = round(covered / max(prompt, 1), 4)
+    out["prefix_cow_copies"] = cows
+    out["prefix_evictions"] = evicted
+    return lines
+
+
 def overlap_section(records: List[dict], out: dict) -> List[str]:
     """Host–device overlap (round 15; ``telemetry/overlap.py``):
     per-replica device-busy fraction, the bubble-cause histogram, and
@@ -576,11 +631,12 @@ def main(argv=None) -> int:
     p.add_argument("--require", default=None,
                    help="comma list of sections that MUST be present "
                         "(goodput, serving, warmup, fleet, pressure, "
-                        "overlap, spans, cost, anomaly) — exit non-zero "
-                        "otherwise; the ci_check.sh --telemetry-smoke, "
-                        "--warmup-smoke, --fleet-smoke, --obs-smoke, "
-                        "--pressure-smoke, --trace-smoke and "
-                        "--overlap-smoke gates")
+                        "prefix, overlap, spans, cost, anomaly) — exit "
+                        "non-zero otherwise; the ci_check.sh "
+                        "--telemetry-smoke, --warmup-smoke, "
+                        "--fleet-smoke, --obs-smoke, --pressure-smoke, "
+                        "--trace-smoke, --overlap-smoke and "
+                        "--prefix-smoke gates")
     args = p.parse_args(argv)
 
     records = load_records(args.paths)
@@ -592,6 +648,7 @@ def main(argv=None) -> int:
     lines += serving_section(records, out)
     lines += fleet_section(records, out)
     lines += pressure_section(records, out)
+    lines += prefix_section(records, out)
     lines += overlap_section(records, out)
     lines += span_section(records, out)
     lines += cost_section(records, out)
@@ -606,6 +663,7 @@ def main(argv=None) -> int:
         "warmup": "warmup_programs" in out,
         "fleet": "fleet_replicas" in out,
         "pressure": out.get("pressure_preempts", 0) > 0,
+        "prefix": out.get("prefix_admissions", 0) > 0,
         "overlap": out.get("overlap_launches", 0) > 0,
         "spans": out.get("span_traces", 0) > 0,
         "cost": out.get("cost_programs", 0) > 0,

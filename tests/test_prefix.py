@@ -1,0 +1,577 @@
+"""Prefix-sharing KV cache (round 17 tentpole): allocator refcounts,
+the radix PrefixIndex, copy-on-write admission, token-identity
+prefix-on vs prefix-off (single replica, int8 pool, disaggregated
+fleet, TP=2), shared blocks pinned through preemption, LRU eviction
+under pool pressure, registry coverage of the COW program, the
+kind="prefix" JSONL schema + report section, and the fleet satellites
+(affinity LRU cap, prefix-sticky gate rung)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.serving import (
+    BlockAllocator,
+    PrefixIndex,
+    Scheduler,
+    blocks_needed_suffix,
+)
+
+
+def setup(max_seq_len=96, **over):
+    cfg = tiny_config(attention="dense", max_seq_len=max_seq_len, **over)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def drive(s, prompts, budgets, stagger=4):
+    """Submit prompts with ``stagger`` ticks between arrivals (so
+    earlier requests' blocks are indexed before later lookups), then
+    drain; returns {rid: [tokens]} in submit order."""
+    outs, rids = {}, []
+    for p, b in zip(prompts, budgets):
+        rids.append(s.submit(p, b))
+        for _ in range(stagger):
+            for rid, tok in s.step():
+                outs.setdefault(rid, []).append(tok)
+    for rid, toks in s.drain().items():
+        outs.setdefault(rid, []).extend(toks)
+    return {r: outs[r] for r in rids}
+
+
+# ---------------------------------------------------------------------------
+# allocator refcounts (pure host logic — fast tier)
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_refcount_free_at_zero_and_double_free():
+    a = BlockAllocator(8)
+    chain = a.alloc(0, 3)
+    assert chain == [1, 2, 3] and all(a.ref(b) == 1 for b in chain)
+    a.incref(1)  # the index's claim
+    a.free(0)
+    # block 1 pinned by the extra ref; 2 and 3 freed
+    assert a.ref(1) == 1 and a.ref(2) == 0 and a.available == 6
+    assert a.shared_blocks == 0
+    with pytest.raises(RuntimeError, match="double free"):
+        a.decref(2)
+    with pytest.raises(ValueError, match="dead block"):
+        a.incref(2)
+    a.decref(1)
+    assert a.available == 7
+
+
+def test_allocator_alloc_mixed_shares_and_pins():
+    a = BlockAllocator(10)
+    donor = a.alloc(0, 3)
+    a.incref(donor[0]); a.incref(donor[1])  # noqa: E702 — index refs
+    a.free(0)  # donor retires; 2 blocks survive as index-only
+    mixed = a.alloc_mixed(1, donor[:2], 2)
+    assert mixed[:2] == donor[:2]
+    assert a.ref(donor[0]) == 2 and a.shared_blocks == 2
+    assert a.fresh_allocated == 5 and a.shared_reused == 2
+    # the sharer frees: shared blocks survive (index ref), fresh don't
+    a.free(1)
+    assert a.ref(donor[0]) == 1 and a.ref(mixed[2]) == 0
+    # sharing a dead block is loud
+    with pytest.raises(ValueError, match="cannot share"):
+        a.alloc_mixed(2, [mixed[2]], 1)
+    # all-or-nothing: OOM increfs NOTHING
+    before = a.ref(donor[0])
+    assert a.alloc_mixed(2, donor[:1], 99) is None
+    assert a.ref(donor[0]) == before
+
+
+def test_allocator_shared_chain_pinned_through_swap_free():
+    """The PR 11 state machine composes with refcounts: a chain mid-swap
+    still refuses to free, and when a swapped-out chain IS freed its
+    shared blocks stay resident for the other holders."""
+    a = BlockAllocator(10)
+    c0 = a.alloc(0, 2)
+    a.incref(c0[0])
+    a.free(0)
+    a.alloc_mixed(1, [c0[0]], 1)
+    a.set_state(1, "swapping-out")
+    with pytest.raises(RuntimeError, match="swapping-out"):
+        a.free(1)
+    a.clear_state(1)
+    a.free(1)  # swap-out committed: chain decrefs...
+    assert a.ref(c0[0]) == 1  # ...but the indexed block never left
+
+
+def test_blocks_needed_suffix_matches_cold_at_zero():
+    assert blocks_needed_suffix(0, 9, 20, 16, 16) == 2
+    # prefill restarting at a covered boundary pads from THERE
+    assert blocks_needed_suffix(16, 20, 2, 8, 8) == 3  # pad 16+8=24→3
+    assert blocks_needed_suffix(16, 17, 30, 8, 8) == 6  # decode bound
+
+
+# ---------------------------------------------------------------------------
+# radix index (pure host logic — fast tier)
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_index_insert_lookup_dedup_evict():
+    a = BlockAllocator(16)
+    idx = PrefixIndex(4, a)
+    toks = np.arange(100, 120, dtype=np.int32)  # 5 full blocks of 4
+    chain = a.alloc(0, 5)
+    assert idx.insert(toks, chain, upto=12) == 3  # floors to full blocks
+    assert len(idx) == 3 and all(a.ref(b) == 2 for b in chain[:3])
+    # dedup: a second chain with the same prefix keeps the FIRST blocks
+    other = a.alloc(1, 3)
+    assert idx.insert(toks, other, upto=12) == 0
+    assert a.ref(other[0]) == 1
+    # lookup: longest full-block match, diverging token stops the walk
+    assert idx.lookup(toks) == chain[:3]
+    fork = toks.copy(); fork[5] += 1  # noqa: E702
+    assert idx.lookup(fork) == chain[:1]
+    assert idx.lookup(np.arange(50, 60, dtype=np.int32)) == []
+    m = idx.metrics()
+    assert m["prefix_hits"] == 2 and m["prefix_lookups"] == 3
+    # eviction: chain-held blocks (ref 2) are pinned — nothing evictable
+    assert idx.evict(3) == 0
+    a.free(0); a.free(1)  # noqa: E702
+    # now index-only (ref 1): leaves evict first, cascading to parents
+    freed = idx.evict(2)
+    assert freed == 2 and len(idx) == 1
+    assert idx.lookup(toks) == chain[:1]  # the surviving root block
+    assert idx.evict(5) == 1 and len(idx) == 0
+    assert a.available == 15
+
+
+def test_prefix_index_lru_prefers_oldest_leaf():
+    a = BlockAllocator(16)
+    idx = PrefixIndex(2, a)
+    t1 = np.asarray([1, 2], np.int32)
+    t2 = np.asarray([3, 4], np.int32)
+    c1 = a.alloc(0, 1); idx.insert(t1, c1, 2); a.free(0)  # noqa: E702
+    c2 = a.alloc(0, 1); idx.insert(t2, c2, 2); a.free(0)  # noqa: E702
+    idx.lookup(t1)  # t1 is now the RECENT one
+    assert idx.evict(1) == 1
+    assert idx.lookup(t1) == c1 and idx.lookup(t2) == []
+
+
+# ---------------------------------------------------------------------------
+# token identity + accounting (tiny model — fast tier)
+# ---------------------------------------------------------------------------
+
+
+def _shared_prompts(cfg, prefix_len=24, tails=(5, 9, 3), seed=0):
+    shared = np.arange(1, prefix_len + 1, dtype=np.int32)
+    rng = np.random.default_rng(seed)
+    return [
+        np.concatenate([
+            shared,
+            rng.integers(1, cfg.vocab_size, (l,)).astype(np.int32),
+        ])
+        for l in tails
+    ]
+
+
+def test_prefix_on_off_token_identity_and_accounting():
+    cfg, params = setup()
+    # tail 8 → 32 tokens, a block multiple: its identical twin below is
+    # a FULL-cover hit, the copy-on-write path
+    prompts = _shared_prompts(cfg, tails=(8, 9, 3))
+    prompts.append(prompts[0].copy())
+    budgets = [6, 6, 6, 6]
+    on = Scheduler(cfg, params, n_slots=3, block_len=8, prefill_chunk=16,
+                   prefix_cache=True)
+    off = Scheduler(cfg, params, n_slots=3, block_len=8, prefill_chunk=16)
+    got_on = drive(on, prompts, budgets)
+    got_off = drive(off, prompts, budgets)
+    assert list(got_on.values()) == list(got_off.values())
+    m_on, m_off = on.metrics(), off.metrics()
+    assert m_on["prefix_hits"] >= 3
+    assert m_on["prefix_cow_copies"] >= 1  # the identical prompt
+    assert m_on["prefix_covered_tokens"] > 0
+    # THE tentpole claim at test scale: shared-prefix admissions prefill
+    # far fewer tokens than the no-sharing engine on the same work
+    assert (m_on["admitted_prefill_tokens"]
+            < m_off["admitted_prefill_tokens"])
+    assert m_off["prefix_hits"] == 0 and not m_off["prefix_cache"]
+    # retirement decrefs but the index retains: blocks in use == indexed
+    assert on.engine.allocator.in_use == m_on["prefix_index_blocks"] > 0
+    # teardown drops the index references too
+    on.engine.release_all()
+    assert on.engine.allocator.in_use == 0
+
+
+def test_prefix_int8_pool_composes():
+    """int8 pools share: block ids name the same rows in the quantized
+    pools AND their fp32 scale siblings, so sharing/COW move both in
+    lockstep — streams identical to the int8 no-sharing engine."""
+    cfg, params = setup()
+    prompts = _shared_prompts(cfg, tails=(8, 9, 3))
+    prompts.append(prompts[0].copy())  # block-aligned twin → COW
+    budgets = [6, 6, 6, 6]
+    on = Scheduler(cfg, params, n_slots=3, block_len=8, prefill_chunk=16,
+                   prefix_cache=True, kv_dtype="int8")
+    off = Scheduler(cfg, params, n_slots=3, block_len=8, prefill_chunk=16,
+                    kv_dtype="int8")
+    assert list(drive(on, prompts, budgets).values()) == \
+        list(drive(off, prompts, budgets).values())
+    assert on.metrics()["prefix_hits"] >= 3
+    assert on.metrics()["prefix_cow_copies"] >= 1
+
+
+def test_prefix_covered_cap_keeps_padded_tail_in_bounds():
+    """A near-full-length prompt's hit is CAPPED so the chunk-padded
+    tail never scatters past max_seq_len (the table-slice safety
+    bound) — and the capped admission still streams identically."""
+    cfg, params = setup(max_seq_len=32)
+    prompt = np.arange(1, 29, dtype=np.int32)  # 28 tokens
+    on = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=8,
+                   prefix_cache=True)
+    off = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=8)
+    prompts, budgets = [prompt, prompt.copy()], [4, 4]
+    assert list(drive(on, prompts, budgets).values()) == \
+        list(drive(off, prompts, budgets).values())
+    m = on.metrics()
+    # full-cover candidate covered=27 would pad to 35 > 32: the cap
+    # drops it to the 24-token block boundary (3 shared blocks, no COW)
+    assert m["prefix_hits"] >= 1
+    assert m["prefix_covered_tokens"] == 24
+    assert m["prefix_cow_copies"] == 0
+
+
+def test_prefix_eviction_under_pool_pressure():
+    """Index-only blocks are the first pool-pressure valve: a new
+    admission that cannot get fresh blocks evicts LRU refcount-1 index
+    blocks and proceeds — queueing (and the pressure tier) only engage
+    when the index has nothing left to give."""
+    cfg, params = setup()
+    s = Scheduler(cfg, params, n_slots=1, n_blocks=8, block_len=8,
+                  prefill_chunk=8, prefix_cache=True)
+    r0 = s.submit(np.arange(1, 17, dtype=np.int32), 2)
+    s.drain()
+    assert s.metrics()["prefix_index_blocks"] >= 2
+    r1 = s.submit(np.arange(40, 80, dtype=np.int32), 2)  # needs 6 blocks
+    outs = s.drain()
+    m = s.metrics()
+    assert len(outs[r1]) == 2 and m["prefix_evictions"] >= 1
+    assert r0 != r1
+
+
+def test_prefix_shared_block_survives_preemption():
+    """COW/refcount under the pressure tier: preempting (swap path) a
+    chain that SHARES prefix blocks must not drag them — the other
+    sharer and the index keep them resident, and every stream (victim
+    included, restored) stays token-identical to the no-sharing,
+    no-preemption engine."""
+    cfg, params = setup()
+    prompts = _shared_prompts(cfg, tails=(5, 7))
+    budgets = [4, 8]
+
+    on = Scheduler(cfg, params, n_slots=3, block_len=8, prefill_chunk=8,
+                   prefix_cache=True, offload=True, swap_policy="swap",
+                   protect_ticks=0)
+    outs = {}
+    rid_a = on.submit(prompts[0], budgets[0])
+    for _ in range(8):  # a retires (4 chunks... then 4 tokens)
+        for rid, tok in on.step():
+            outs.setdefault(rid, []).append(tok)
+    assert len(outs.get(rid_a, [])) == budgets[0]
+    rid_b = on.submit(prompts[1], budgets[1])
+    for _ in range(4):  # b hits the prefix, prefills, starts decoding
+        for rid, tok in on.step():
+            outs.setdefault(rid, []).append(tok)
+    alloc = on.engine.allocator
+    shared = [b for b in range(1, alloc.n_blocks) if alloc.ref(b) > 1]
+    assert len(shared) >= 3  # b rides a's indexed prefix blocks
+    assert on.preempt(rid_b, reason="test").choice == "swap"
+    for _ in range(2):
+        for rid, tok in on.step():
+            outs.setdefault(rid, []).append(tok)
+    # mid-park: the victim's free decref'd, the index still pins them
+    for b in shared:
+        assert alloc.ref(b) >= 1, f"shared block {b} was dragged"
+    for rid, toks in on.drain().items():
+        outs.setdefault(rid, []).extend(toks)
+    m = on.metrics()
+    assert m["preempts"] == 1 and m["restores"] == 1
+
+    off = Scheduler(cfg, params, n_slots=3, block_len=8, prefill_chunk=8)
+    ref = {}
+    ra = off.submit(prompts[0], budgets[0])
+    for _ in range(8):
+        for rid, tok in off.step():
+            ref.setdefault(rid, []).append(tok)
+    rb = off.submit(prompts[1], budgets[1])
+    for rid, toks in off.drain().items():
+        ref.setdefault(rid, []).extend(toks)
+    assert outs[rid_a] == ref[ra] and outs[rid_b] == ref[rb]
+
+
+def test_prefix_recompute_restore_hits_own_prefix():
+    """The recompute-restore re-prefill consults the index: a parked
+    request whose prompt blocks are still retained re-prefills only its
+    uncovered tail — and resumes bit-exact."""
+    cfg, params = setup()
+    prompts = _shared_prompts(cfg, tails=(5,))
+    on = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=8,
+                   prefix_cache=True, offload=True,
+                   swap_policy="recompute", protect_ticks=0)
+    outs = {}
+    rid = on.submit(prompts[0], 8)
+    for _ in range(6):
+        for r, tok in on.step():
+            outs.setdefault(r, []).append(tok)
+    hits_before = on.metrics()["prefix_hits"]
+    assert on.preempt(rid, reason="test").choice == "recompute"
+    for r, toks in on.drain().items():
+        outs.setdefault(r, []).extend(toks)
+    assert on.metrics()["prefix_hits"] > hits_before  # restore hit
+    off = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=8)
+    roff = off.submit(prompts[0], 8)
+    assert outs[rid] == off.drain()[roff]
+
+
+# ---------------------------------------------------------------------------
+# registry coverage (compilecache gate)
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_registry_covers_cow_program():
+    from pytorch_distributed_tpu.compilecache import serving_registry
+
+    cfg, params = setup()
+    prompts = _shared_prompts(cfg, tails=(8,))
+    prompts.append(prompts[0].copy())  # forces the COW program
+    on = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=16,
+                   prefix_cache=True)
+    drive(on, prompts, [4, 4])
+    assert on.metrics()["prefix_cow_copies"] >= 1
+    names = on.engine.compiled_program_names()
+    assert "kv_block_copy" in names
+    reg = serving_registry(on.engine)
+    reg.assert_covers(names)  # zero rogue programs incl. the hit path
+    # a no-prefix engine predicts no COW program — and cannot run it
+    off = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=16)
+    reg_off = serving_registry(off.engine)
+    assert not reg_off.predicts("kv_block_copy")
+    with pytest.raises(RuntimeError, match="prefix_cache"):
+        off.engine.admit_shared(0, prompts[0], 4)
+    with pytest.raises(RuntimeError, match="prefix_cache"):
+        off.engine.warm_block_copy()
+    # fingerprints must not be interchangeable across the flag
+    assert reg.fingerprint != reg_off.fingerprint
+
+
+def test_prefix_warm_block_copy_inert():
+    from pytorch_distributed_tpu.compilecache import serving_registry
+
+    cfg, params = setup()
+    s = Scheduler(cfg, params, n_slots=2, block_len=8, prefill_chunk=16,
+                  prefix_cache=True)
+    pool_before = np.asarray(jax.tree.leaves(s.engine.cache)[0][1:]).copy()
+    s.engine.warm_block_copy(execute=True)  # trash → trash self-copy
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(s.engine.cache)[0][1:]), pool_before
+    )
+    compiled = s.engine.warm_block_copy(execute=False)
+    assert compiled is not None  # the cost-card AOT branch
+    serving_registry(s.engine).assert_covers(
+        s.engine.compiled_program_names()
+    )
+
+
+# ---------------------------------------------------------------------------
+# fleet: disagg identity, affinity LRU, sticky rung, JSONL/report
+# ---------------------------------------------------------------------------
+
+
+def _fleet_trace(n=10, sessions=4):
+    from pytorch_distributed_tpu.fleet import generate_trace
+
+    return generate_trace(
+        seed=3, duration_s=float(4 * n), base_rate=n / (4.0 * n),
+        burst_rate_mult=2.0, burst_every_s=10.0, burst_len_s=2.0,
+        sessions=sessions, prompt_median=10, prompt_sigma=0.6,
+        prompt_min=4, prompt_max=24, max_new_median=5,
+        max_new_sigma=0.4, max_new_min=2, max_new_max=8,
+    )
+
+
+def _replay(router, trace, cfg, prefix_len=24):
+    from pytorch_distributed_tpu.fleet import (
+        replay_trace,
+        shared_prefix_prompt_for,
+    )
+
+    replay_trace(
+        trace,
+        lambda r: router.submit(
+            shared_prefix_prompt_for(r, cfg.vocab_size, prefix_len),
+            r.max_new, session=r.session,
+        ),
+        router.step,
+        lambda: router.idle,
+    )
+    return dict(router.results)
+
+
+def test_prefix_fleet_and_disagg_handoff_identity(tmp_path):
+    """Shared-prefix chains cross the disaggregated prefill→decode
+    handoff intact (export gathers shared blocks, the decode pool gets
+    its own exclusive copies) and both the plain and disagg prefix
+    fleets stream token-identically to the prefix-off fleet. Also the
+    rollup + JSONL end of the satellite: fleet metrics carry the hit
+    rate and the ON run's stream validates against the schema
+    registry."""
+    from pytorch_distributed_tpu.fleet import FleetRouter
+    from pytorch_distributed_tpu.telemetry.schema import validate_stream
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    cfg, params = setup(max_seq_len=64)
+    trace = _fleet_trace()
+    kw = dict(n_slots=3, block_len=8, prefill_chunk=16, admit_per_step=4)
+    path = tmp_path / "prefix.jsonl"
+    mlog = MetricsLogger(str(path))
+    on = FleetRouter(cfg, params, n_replicas=2, prefix_cache=True,
+                     metrics_log=mlog, **kw)
+    got_on = _replay(on, trace, cfg)
+    on.log_summary()
+    mlog.close()
+    off = FleetRouter(cfg, params, n_replicas=2, **kw)
+    got_off = _replay(off, trace, cfg)
+    assert got_on == got_off
+    disagg = FleetRouter(cfg, params, n_replicas=2, disaggregate=True,
+                         prefix_cache=True, **kw)
+    assert _replay(disagg, trace, cfg) == got_off
+    assert disagg.metrics()["handoffs"] > 0
+    m = on.metrics()
+    assert m["prefix_hits"] > 0 and 0 < m["prefix_hit_rate"] <= 1
+    assert m["admitted_prefill_tokens"] < off.metrics()[
+        "admitted_prefill_tokens"]
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert not validate_stream(records)
+    assert any(r.get("kind") == "prefix" and r.get("covered", 0) > 0
+               for r in records)
+    # fleet-wide coverage guard stays green with the COW/hit paths live
+    on.assert_registry_covers()
+    disagg.assert_registry_covers()
+
+
+def test_prefix_report_section(tmp_path):
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        for i in range(4):
+            f.write(json.dumps({
+                "kind": "prefix", "rid": i, "replica_id": 0,
+                "prompt_len": 40, "covered": 24 if i else 0,
+                "shared_blocks": 3 if i else 0, "cow": i == 3,
+                "evicted": 0, "ts": float(i),
+            }) + "\n")
+    assert telemetry_report.main([str(path), "--require", "prefix"]) == 0
+    assert telemetry_report.main([str(path), "--require", "pressure"]) == 2
+
+
+def test_affinity_lru_cap_regression():
+    """The round-17 satellite fix: the router's session-affinity table
+    is LRU-bounded — 100k sessions can no longer grow it without
+    bound, and recently-routed sessions survive the cap."""
+    from pytorch_distributed_tpu.fleet import FleetRouter
+
+    cfg, params = setup(max_seq_len=64)
+    router = FleetRouter(cfg, params, n_replicas=2, affinity_cap=4,
+                         n_slots=3, block_len=8, prefill_chunk=16)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    for sess in range(6):
+        router.submit(prompt, 2, session=sess)
+    router.submit(prompt, 2, session=2)  # touch keeps session 2 recent
+    router.submit(prompt, 2, session=6)  # evicts the LRU entry
+    router.drain()
+    m = router.metrics()
+    assert len(router._affinity) <= 4
+    assert m["affinity_evictions"] >= 2 and m["affinity_sessions"] <= 4
+    assert 2 in router._affinity and 0 not in router._affinity
+    with pytest.raises(ValueError, match="affinity_cap"):
+        FleetRouter(cfg, params, n_replicas=2, affinity_cap=0,
+                    n_slots=3, block_len=8, prefill_chunk=16)
+
+
+def test_gate_prefix_sticky_rung():
+    from pytorch_distributed_tpu.fleet import SLOConfig, SLOGate
+    from pytorch_distributed_tpu.fleet.admission import ADMIT, SPILL
+
+    def m(depth, prefix=True, draining=False):
+        return {"queue_depth": depth, "occupancy": 0.5,
+                "prefix_cache": prefix, "draining": draining}
+
+    gate = SLOGate(SLOConfig(spill_queue_depth=4, shed_queue_depth=64,
+                             prefix_sticky_depth=8))
+    # hot only by queue depth + prefix resident → stay sticky
+    d = gate.route({0: m(5), 1: m(0)}, preferred=0)
+    assert d.action == ADMIT and d.replica == 0
+    assert d.reason == "prefix-sticky"
+    # past the sticky bound → spill as before
+    d = gate.route({0: m(9), 1: m(0)}, preferred=0)
+    assert d.action == SPILL and d.replica == 1
+    # no prefix cache on the replica → the rung does not apply
+    d = gate.route({0: m(5, prefix=False), 1: m(0)}, preferred=0)
+    assert d.action == SPILL
+    # draining is never sticky
+    d = gate.route({0: m(5, draining=True), 1: m(0)}, preferred=0)
+    assert d.action == SPILL
+    # default config: rung off, historical behavior bit-identical
+    d = SLOGate(SLOConfig(spill_queue_depth=4)).route(
+        {0: m(5), 1: m(0)}, preferred=0
+    )
+    assert d.action == SPILL
+    with pytest.raises(ValueError, match="prefix_sticky_depth"):
+        SLOConfig(spill_queue_depth=4, shed_queue_depth=8,
+                  prefix_sticky_depth=9)
+
+
+# ---------------------------------------------------------------------------
+# TP=2 (slow tier, like the other TP parity tests)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_prefix_tp2_token_identity():
+    """TP=2 CPU mesh: the head-sharded pool shares blocks per shard
+    (same ids, each shard's head slice) and the COW program copies
+    under shard_map — streams identical to the TP=2 no-sharing
+    scheduler."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.parallel import make_mesh
+
+    rep = tiny_config(attention="dense", max_seq_len=96, num_heads=4)
+    tpcfg = dataclasses.replace(rep, model_axis="model", tp_size=2)
+    params = TransformerLM(rep).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = make_mesh(jax.devices()[:2], data_parallel=1, seq_parallel=1,
+                     model_parallel=2)
+    prompts = _shared_prompts(rep, tails=(8, 9))
+    prompts.append(prompts[0].copy())  # block-aligned twin: COW under TP
+    budgets = [5, 5, 5]
+    on = Scheduler(tpcfg, params, n_slots=2, block_len=8,
+                   prefill_chunk=16, mesh=mesh, prefix_cache=True)
+    off = Scheduler(tpcfg, params, n_slots=2, block_len=8,
+                    prefill_chunk=16, mesh=mesh)
+    assert list(drive(on, prompts, budgets).values()) == \
+        list(drive(off, prompts, budgets).values())
+    assert on.metrics()["prefix_hits"] >= 2
+    assert on.metrics()["prefix_cow_copies"] >= 1
