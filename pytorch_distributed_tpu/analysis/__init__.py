@@ -15,6 +15,12 @@ parameter replicated. This package catches them before they cost a run:
   model parameter trees;
 - ``guards``: runtime companions (``no_recompile``) that wrap a train step
   and assert-fail on jit cache growth or host transfers after warmup;
+- ``blocksan``: the runtime block-lifecycle sanitizer — a shadow ledger
+  over the serving stack's paged KV allocator (``PDT_BLOCKSAN=1``) that
+  detects leak-at-retire, double-free, refcount underflow,
+  use-after-free, pinned-block violations, and ledger/allocator drift
+  at quiesce (the static ``lifecycle-*`` rule family is its compile-time
+  half);
 - ``sarif``/``cache``: SARIF 2.1.0 emission for CI annotation surfaces
   and the content-hash incremental mode behind ``--incremental``.
 
@@ -50,4 +56,10 @@ from pytorch_distributed_tpu.analysis.guards import (  # noqa: F401
     GuardStats,
     GuardViolation,
     no_recompile,
+)
+from pytorch_distributed_tpu.analysis.blocksan import (  # noqa: F401
+    BlockSanError,
+    BlockSanitizer,
+    Violation,
+    maybe_sanitizer,
 )

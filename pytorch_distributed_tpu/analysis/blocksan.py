@@ -1,0 +1,512 @@
+"""blocksan — runtime block-lifecycle sanitizer for the paged KV pool.
+
+The serving stack's hardest invariants live in ``serving/kv_pool.py``:
+refcounted blocks shared across chains and the prefix index, swap
+windows that pin chains mid-flight, disaggregated handoffs that move a
+chain between pools. The allocator already raises on the violations it
+can see locally (double free, freeing a mid-swap chain); what nothing
+checked until this round is the GLOBAL story — a request that retires
+while its chain is still held, a table row naming a recycled block, a
+handoff source freed before the adopting replica committed. Leaked
+blocks are capacity corruption: at fleet scale they surface as mystery
+sheds, never as a stack trace. This is the ASan/LeakSanitizer move
+applied to paged KV memory.
+
+The sanitizer keeps a **shadow ledger** fully independent of the
+allocator's own books: every alloc / incref / decref / free / swap
+state change flows through ``BlockAllocator.sanitizer`` hooks (one
+attribute test per op when detached — the ``fault_point`` precedent),
+and the engine/scheduler annotate the semantic sites (admit, COW,
+swap-out, handoff export, retire). Each ledger event records
+``(block_id, owner, rid, span_id, site)``; a *span* is one block's
+lifetime from fresh allocation to refcount zero.
+
+Violation classes (``Violation.kind``):
+
+====================  =====================================================
+``leak-at-retire``    a request retired (or was cancelled / handed off)
+                      while the shadow ledger still shows its owner slot
+                      holding a chain — blocks the scheduler will never
+                      free again
+``double-free``       decref of a block the ledger already saw die (the
+                      allocator raises too; the sanitizer records WHERE,
+                      with rid/site attribution, before it does)
+``refcount-underflow``
+                      a shadow refcount would cross below zero, or
+                      ``verify`` finds a non-positive count in either
+                      ledger — someone mutated refcounts outside the API
+``use-after-free``    a freed block id observed where only live blocks
+                      may appear: a block-table row, an incref, or the
+                      free list handing out a block the ledger still
+                      holds live
+``pinned-block``      freeing a chain pinned by an in-flight swap window
+                      or an exported-not-yet-adopted handoff (the swap
+                      case also raises in the allocator; the handoff pin
+                      is ONLY visible here)
+``quiesce-mismatch``  at quiesce the shadow ledger and the allocator
+                      disagree: refcounts differ, the free list names a
+                      live block, a block is neither free nor live, or
+                      the free list holds duplicates
+====================  =====================================================
+
+Enablement: ``PDT_BLOCKSAN=1`` in the environment (``maybe_sanitizer``
+returns None otherwise, and nothing is installed — the serving hot
+path pays one ``is not None`` test per allocator op). Violations are
+recorded (``sanitizer.violations``), optionally streamed as
+``kind="sanitizer"`` JSONL records, and ``assert_clean()`` turns them
+into one loud error — the CI smoke gate. Known boundaries are
+documented in ANALYSIS.md ("blocksan" section): the sanitizer watches
+block *identity*, not block *contents*, and a replica's shadow is
+single-threaded by the same rule as the allocator it mirrors.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from collections import deque
+from typing import Dict, List, Optional
+
+ENV_FLAG = "PDT_BLOCKSAN"
+
+VIOLATION_KINDS = (
+    "leak-at-retire",
+    "double-free",
+    "refcount-underflow",
+    "use-after-free",
+    "pinned-block",
+    "quiesce-mismatch",
+)
+
+
+def enabled(env: str = ENV_FLAG) -> bool:
+    """True when the sanitizer is switched on for this process."""
+    return os.environ.get(env, "").strip().lower() in ("1", "true", "on")
+
+
+def maybe_sanitizer(metrics_log=None, replica_id: int = 0):
+    """The one enablement gate: a :class:`BlockSanitizer` when
+    ``PDT_BLOCKSAN=1``, else ``None`` — callers hold the None and every
+    hook site stays a single attribute test."""
+    if not enabled():
+        return None
+    return BlockSanitizer(metrics_log=metrics_log, replica_id=replica_id)
+
+
+class BlockSanError(RuntimeError):
+    """Raised by ``assert_clean`` when the ledger recorded violations."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    kind: str          # one of VIOLATION_KINDS
+    block: int         # block id (-1 when not block-scoped)
+    owner: int         # allocator owner / slot id (-1 unknown)
+    rid: Optional[int]  # request id, when the owner resolved to one
+    site: str          # semantic site label active when it fired
+    detail: str
+
+    def __post_init__(self):
+        if self.kind not in VIOLATION_KINDS:
+            raise ValueError(
+                f"unknown violation kind {self.kind!r}; "
+                f"known: {VIOLATION_KINDS}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class LedgerEvent:
+    """One shadow-ledger entry: what happened to which block, under
+    which owner/request, in which span, at which semantic site."""
+    seq: int
+    shadow: str        # attach name (fleet: "replica3")
+    event: str         # alloc/share/incref/decref/dead/free/state/cow/pin
+    block: int
+    owner: int
+    rid: Optional[int]
+    span: int          # block-lifetime span id (0 = none)
+    site: str
+
+
+class AllocatorShadow:
+    """The per-allocator shadow ledger — installed as
+    ``BlockAllocator.sanitizer`` so the allocator's hook sites reach it
+    directly. Maintains its OWN refcounts, chains, swap states and pin
+    set; agreement with the allocator is asserted, never assumed."""
+
+    def __init__(self, san: "BlockSanitizer", allocator, name: str):
+        self.san = san
+        self.allocator = allocator
+        self.name = name
+        self.refs: Dict[int, int] = {}         # live block -> shadow refcount
+        self.chains: Dict[int, List[int]] = {}  # owner -> chain
+        self.states: Dict[int, str] = {}       # owner -> open swap window
+        self.pins: Dict[int, str] = {}         # owner -> pin reason
+        self.spans: Dict[int, int] = {}        # live block -> span id
+        #: owner slot -> rid resolver (scheduler wires its _slot2rid)
+        self.resolve_rid = lambda owner: None
+        self._site = "allocator"
+
+    # ---- semantic annotations (engine / scheduler side) ----
+
+    @contextlib.contextmanager
+    def site(self, label: str):
+        """Label the allocator ops inside the block with a semantic
+        site (``admit``, ``cow``, ``swap_out`` …) for the ledger."""
+        prev, self._site = self._site, label
+        try:
+            yield
+        finally:
+            self._site = prev
+
+    def pin(self, owner: int, reason: str) -> None:
+        """Pin ``owner``'s chain (handoff export in flight): a free
+        before :meth:`unpin` is a ``pinned-block`` violation even
+        though the allocator itself would allow it."""
+        self.pins[owner] = reason
+
+    def unpin(self, owner: int) -> None:
+        self.pins.pop(owner, None)
+
+    def note_cow(self, owner: int, src: int, dst: int) -> None:
+        """Record a copy-on-write duplication: ``dst`` (already in
+        ``owner``'s fresh suffix) now carries ``src``'s contents."""
+        self._event("cow", dst, owner, detail_block=src)
+
+    # ---- allocator hooks (serving/kv_pool.py call sites) ----
+
+    def on_alloc(self, owner: int, shared: List[int],
+                 fresh: List[int]) -> None:
+        for b in shared:
+            if b not in self.refs:
+                self._violate(
+                    "use-after-free", b, owner,
+                    f"chain for owner {owner} shares block {b} which the "
+                    f"ledger saw die",
+                )
+                self.refs[b] = 0  # resurrect so bookkeeping continues
+                self.spans[b] = self.san._next_span()
+            self.refs[b] += 1
+            self._event("share", b, owner)
+        for b in fresh:
+            if b in self.refs:
+                self._violate(
+                    "use-after-free", b, owner,
+                    f"free list handed out block {b} which the ledger "
+                    f"still holds live (ref {self.refs[b]})",
+                )
+            self.refs[b] = 1
+            self.spans[b] = self.san._next_span()
+            self._event("alloc", b, owner)
+        self.chains[owner] = list(shared) + list(fresh)
+
+    def on_incref(self, block: int) -> None:
+        if block not in self.refs:
+            self._violate(
+                "use-after-free", block, -1,
+                f"incref of block {block} after the ledger saw it die",
+            )
+            return
+        self.refs[block] += 1
+        self._event("incref", block, -1)
+
+    def on_decref(self, block: int) -> None:
+        n = self.refs.get(block)
+        if n is None:
+            self._violate(
+                "double-free", block, -1,
+                f"decref of block {block} after the ledger saw it die",
+            )
+            return
+        if n <= 0:
+            self._violate(
+                "refcount-underflow", block, -1,
+                f"decref would take block {block}'s refcount to {n - 1}",
+            )
+            del self.refs[block]
+            self.spans.pop(block, None)
+            return
+        n -= 1
+        if n == 0:
+            del self.refs[block]
+            self._event("dead", block, -1)
+            self.spans.pop(block, None)
+        else:
+            self.refs[block] = n
+            self._event("decref", block, -1)
+
+    def on_free(self, owner: int, state: Optional[str]) -> None:
+        if state is not None:
+            self._violate(
+                "pinned-block", -1, owner,
+                f"free of owner {owner}'s chain inside an open "
+                f"{state} swap window",
+            )
+            return  # the allocator raises; its chain stays
+        if owner in self.pins:
+            self._violate(
+                "pinned-block", -1, owner,
+                f"free of owner {owner}'s chain while pinned for "
+                f"{self.pins[owner]} — the allocator allows this; the "
+                f"peer holding the pin does not",
+            )
+        chain = self.chains.pop(owner, None)
+        if chain is not None:
+            self._event("free", -1, owner)
+        # the allocator's per-block decrefs follow through on_decref
+
+    def on_state(self, owner: int, state: Optional[str]) -> None:
+        if state is None:
+            self.states.pop(owner, None)
+        else:
+            self.states[owner] = state
+        self._event("state", -1, owner)
+
+    # ---- checks ----
+
+    def check_retire(self, owner: int, rid: Optional[int] = None,
+                     site: str = "retire") -> None:
+        """A request just finished on ``owner``'s slot: the ledger must
+        show no chain left under that owner (shared blocks legitimately
+        survive under OTHER refs; the chain itself must be gone)."""
+        chain = self.chains.get(owner)
+        if chain is not None:
+            self._violate(
+                "leak-at-retire", -1, owner,
+                f"owner {owner} retired holding blocks {chain} the "
+                f"scheduler will never free",
+                rid=rid, site=site,
+            )
+        if owner in self.states:
+            self._violate(
+                "pinned-block", -1, owner,
+                f"owner {owner} retired inside an open "
+                f"{self.states[owner]} swap window",
+                rid=rid, site=site,
+            )
+
+    def check_tables(self, tables, trash_block: int = 0) -> None:
+        """Sweep the engine's block tables: every non-trash id must be
+        ledger-live — a dead id here is a lookup of recycled memory."""
+        import numpy as np
+
+        arr = np.asarray(tables)
+        for slot in range(arr.shape[0]):
+            for b in np.unique(arr[slot]):
+                b = int(b)
+                if b != trash_block and b not in self.refs:
+                    self._violate(
+                        "use-after-free", b, slot,
+                        f"table row {slot} names block {b} which the "
+                        f"ledger saw die",
+                        site="table-sweep",
+                    )
+
+    def verify(self, site: str = "quiesce") -> List[Violation]:
+        """Ledger ≡ allocator: shadow refcounts match the allocator's,
+        the free list is exactly the non-live ids with no duplicates,
+        and no count in either book is non-positive. Returns (and
+        records) the violations found."""
+        a = self.allocator
+        before = len(self.san.violations)
+        live_a = dict(a._refs)
+        for b, n in sorted(live_a.items()):
+            if n <= 0:
+                self._violate(
+                    "refcount-underflow", b, -1,
+                    f"allocator holds refcount {n} for block {b}",
+                    site=site,
+                )
+            sn = self.refs.get(b)
+            if sn is None:
+                self._violate(
+                    "quiesce-mismatch", b, -1,
+                    f"allocator holds block {b} live (ref {n}); the "
+                    f"ledger saw it die",
+                    site=site,
+                )
+            elif sn != n:
+                self._violate(
+                    "quiesce-mismatch", b, -1,
+                    f"refcount disagreement on block {b}: allocator "
+                    f"{n}, ledger {sn}",
+                    site=site,
+                )
+        for b in sorted(set(self.refs) - set(live_a)):
+            self._violate(
+                "quiesce-mismatch", b, -1,
+                f"ledger holds block {b} live (ref {self.refs[b]}); "
+                f"the allocator freed it",
+                site=site,
+            )
+        free = list(a._free)
+        if len(free) != len(set(free)):
+            dupes = sorted(b for b in set(free) if free.count(b) > 1)
+            self._violate(
+                "quiesce-mismatch", dupes[0], -1,
+                f"free list holds duplicate block ids {dupes}",
+                site=site,
+            )
+        for b in free:
+            if b in live_a:
+                self._violate(
+                    "use-after-free", b, -1,
+                    f"free list offers block {b} which is still live "
+                    f"(ref {live_a[b]})",
+                    site=site,
+                )
+        missing = set(range(1, a.n_blocks)) - set(free) - set(live_a)
+        for b in sorted(missing):
+            self._violate(
+                "quiesce-mismatch", b, -1,
+                f"block {b} is neither free nor live — dropped from "
+                f"both books",
+                site=site,
+            )
+        return self.san.violations[before:]
+
+    def verify_quiesce(self) -> List[Violation]:
+        """The end-of-run gate: ledger ≡ allocator AND no owner still
+        holds a chain, a swap window, or a pin. (Index-retained blocks
+        — refcounted but chainless — are legitimately live.)"""
+        before = len(self.san.violations)
+        self.verify(site="quiesce")
+        for owner, chain in sorted(self.chains.items()):
+            self._violate(
+                "leak-at-retire", -1, owner,
+                f"owner {owner} still holds blocks {chain} at quiesce",
+                site="quiesce",
+            )
+        for owner, state in sorted(self.states.items()):
+            self._violate(
+                "pinned-block", -1, owner,
+                f"owner {owner} still inside an open {state} swap "
+                f"window at quiesce", site="quiesce",
+            )
+        for owner, reason in sorted(self.pins.items()):
+            self._violate(
+                "pinned-block", -1, owner,
+                f"owner {owner} still pinned for {reason} at quiesce",
+                site="quiesce",
+            )
+        found = self.san.violations[before:]
+        self.san._log_quiesce(self, found)
+        return found
+
+    # ---- internals ----
+
+    def _event(self, event: str, block: int, owner: int,
+               detail_block: Optional[int] = None) -> None:
+        self.san._record_event(LedgerEvent(
+            seq=self.san._next_seq(), shadow=self.name, event=event,
+            block=block, owner=owner,
+            rid=self.resolve_rid(owner) if owner >= 0 else None,
+            span=self.spans.get(detail_block if detail_block is not None
+                                else block, 0),
+            site=self._site,
+        ))
+
+    def _violate(self, kind: str, block: int, owner: int, detail: str,
+                 rid: Optional[int] = None,
+                 site: Optional[str] = None) -> None:
+        if rid is None and owner >= 0:
+            rid = self.resolve_rid(owner)
+        self.san._record_violation(self, Violation(
+            kind=kind, block=block, owner=owner, rid=rid,
+            site=site if site is not None else self._site, detail=detail,
+        ))
+
+
+class BlockSanitizer:
+    """The process-level sanitizer: one per run, attached to each
+    replica's allocator (``attach``). Aggregates violations and the
+    bounded event ledger across shadows; streams ``kind="sanitizer"``
+    JSONL when given a ``metrics_log``."""
+
+    #: ledger ring size — enough to reconstruct any block's recent
+    #: history at test scale without unbounded growth under a long run
+    MAX_EVENTS = 20_000
+
+    def __init__(self, metrics_log=None, replica_id: int = 0):
+        self.metrics_log = metrics_log
+        self.replica_id = replica_id
+        self.violations: List[Violation] = []
+        self.events: deque = deque(maxlen=self.MAX_EVENTS)
+        self.events_total = 0
+        self._seq = 0
+        self._span = 0
+        self.shadows: List[AllocatorShadow] = []
+
+    def attach(self, allocator, name: str = "pool",
+               resolve_rid=None) -> AllocatorShadow:
+        """Install a shadow on ``allocator`` and return it. Idempotent
+        per allocator (re-attach replaces, ledger state reset)."""
+        shadow = AllocatorShadow(self, allocator, name)
+        if resolve_rid is not None:
+            shadow.resolve_rid = resolve_rid
+        self.shadows = [
+            s for s in self.shadows if s.allocator is not allocator
+        ] + [shadow]
+        allocator.sanitizer = shadow
+        return shadow
+
+    def assert_clean(self) -> None:
+        """Raise :class:`BlockSanError` listing every recorded
+        violation — the CI smoke leg's one-call gate."""
+        if not self.violations:
+            return
+        lines = [
+            f"  [{v.kind}] block={v.block} owner={v.owner} rid={v.rid} "
+            f"site={v.site}: {v.detail}"
+            for v in self.violations
+        ]
+        raise BlockSanError(
+            f"blocksan recorded {len(self.violations)} violation(s):\n"
+            + "\n".join(lines)
+        )
+
+    def summary(self) -> dict:
+        """Rollup for ``metrics()`` surfaces."""
+        by_kind: Dict[str, int] = {}
+        for v in self.violations:
+            by_kind[v.kind] = by_kind.get(v.kind, 0) + 1
+        return {
+            "blocksan_violations": len(self.violations),
+            "blocksan_events": self.events_total,
+            "blocksan_by_kind": by_kind,
+        }
+
+    # ---- internals ----
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _next_span(self) -> int:
+        self._span += 1
+        return self._span
+
+    def _record_event(self, ev: LedgerEvent) -> None:
+        self.events.append(ev)
+        self.events_total += 1
+
+    def _record_violation(self, shadow: AllocatorShadow,
+                          v: Violation) -> None:
+        self.violations.append(v)
+        if self.metrics_log is not None:
+            self.metrics_log.log(
+                kind="sanitizer", ev="violation", **{"class": v.kind},
+                block=v.block, owner=v.owner, rid=v.rid, site=v.site,
+                detail=v.detail, shadow=shadow.name,
+                replica_id=self.replica_id,
+            )
+
+    def _log_quiesce(self, shadow: AllocatorShadow,
+                     found: List[Violation]) -> None:
+        if self.metrics_log is not None:
+            self.metrics_log.log(
+                kind="sanitizer", ev="quiesce", ok=not found,
+                violations=len(found), live_blocks=len(shadow.refs),
+                shadow=shadow.name, replica_id=self.replica_id,
+            )

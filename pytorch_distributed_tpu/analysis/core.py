@@ -203,7 +203,7 @@ def collect_axis_constants(modules: Sequence[ParsedModule]) -> Dict[str, str]:
 Rule = Callable[[ParsedModule, LintContext], List[Finding]]
 
 #: bump when any rule's behaviour changes — invalidates incremental caches
-RULE_VERSION = "jaxlint-2.0"
+RULE_VERSION = "jaxlint-2.1"
 
 # partition-coverage is the one rule whose implementation needs a live
 # jax import, so its catalogue entry lives here (stdlib territory), not
@@ -227,6 +227,7 @@ def _rule_modules():
         rules_collectives,
         rules_donation,
         rules_host_transfer,
+        rules_lifecycle,
         rules_precision,
         rules_recompile,
         rules_sharding,
@@ -241,6 +242,7 @@ def _rule_modules():
         rules_donation,
         rules_sharding,
         rules_threads,
+        rules_lifecycle,
     ]
 
 

@@ -1,0 +1,368 @@
+"""Rule family: lifecycle — KV block ownership and span discipline.
+
+The runtime half of blocksan (``analysis/blocksan.py``) proves a TRACE
+leak-free; this family proves properties of the CODE: every path out of
+a function that acquired pool blocks either commits them to a table or
+frees them, nobody touches the allocator's private books, and swap-span
+open/close calls balance across outcome paths. The three rules target
+the exact bug shapes the sanitizer catches at runtime — so a violation
+the kill matrix would need a fault injection to surface is flagged at
+lint time instead.
+
+- ``lifecycle-alloc-leak`` (error): a function assigns the result of an
+  allocator acquire (``.alloc(``, ``.alloc_mixed(``, ``._alloc_evict(``)
+  and a ``raise`` or early ``return`` is lexically reachable after it,
+  before the chain is committed to a block table row or freed. The OOM
+  idiom — ``if chain is None: return ...`` — is recognized as clean
+  (nothing was allocated on that path), as is a raise preceded by a
+  ``.free(`` call (the try/except release shape ``import_chain`` uses),
+  and returning the chain itself (the hand-off idiom ``_alloc_evict``
+  uses).
+- ``lifecycle-refcount-outside-allocator`` (error): writes to the
+  allocator's private books (``._refs``/``._free``/``._chains``/
+  ``._states``) or ``.incref(``/``.decref(`` calls outside
+  ``serving/kv_pool.py``. The allocator's invariants — all-or-nothing
+  alloc, loud double-free, swap-window pinning, the sanitizer hooks —
+  hold only when every mutation flows through its API; a stray
+  ``allocator._refs[b] += 1`` is invisible to all of them.
+- ``lifecycle-span-imbalance`` (warning): swap-span open calls
+  (``.set_state(``, ``.swap_out_begin(``) without a matching close
+  (``.clear_state(``, ``.swap_out_finish(``) in the same function —
+  either no close on ANY path, or a ``raise`` after the open with no
+  close lexically between. Cross-function window protocols (the
+  scheduler opens in ``preempt`` and closes in ``_finalize_swaps`` next
+  tick) are real and deliberate — suppress inline with the reason, so
+  the protocol is recorded next to the open it justifies.
+
+Boundaries (documented in ANALYSIS.md): the analysis is lexical within
+one function — acquire/release pairs split across functions need a
+suppression stating the protocol; "commit" means a store into a
+``.tables``-named subscript, so an engine committing through a helper
+would need its commit recognized the same way; aliasing (``a = self
+.allocator; a._refs[...]``) is visible, but re-exporting the books
+through another name is not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ParsedModule,
+    RuleInfo,
+)
+
+RULES = [
+    RuleInfo(
+        "lifecycle-alloc-leak", "error",
+        "allocated block chain can escape through a raise/early return "
+        "before table commit or free",
+        "A function assigns the result of a pool acquire — .alloc(), "
+        ".alloc_mixed(), ._alloc_evict() — and then a raise statement or "
+        "an early return is lexically reachable before the chain is "
+        "committed to a block-table row or freed. On that edge the "
+        "blocks are live in the allocator but referenced by nothing the "
+        "scheduler tracks: a permanent capacity leak that surfaces only "
+        "as mystery pool pressure (blocksan reports it as "
+        "leak-at-retire, but only on a run that actually takes the "
+        "edge). Guard the window with try/except that frees the chain "
+        "and re-raises (the import_chain shape), or commit before "
+        "raising. The OOM idiom `if chain is None: return ...` is "
+        "recognized as clean — nothing was allocated on that path — and "
+        "so is returning the chain itself to a caller that owns it.",
+    ),
+    RuleInfo(
+        "lifecycle-refcount-outside-allocator", "error",
+        "allocator private books mutated (or incref/decref called) "
+        "outside serving/kv_pool.py",
+        "The BlockAllocator's invariants — all-or-nothing alloc_mixed, "
+        "loud double-free, swap-window pinning, the blocksan shadow "
+        "hooks — hold only when every refcount and free-list mutation "
+        "flows through its API from within serving/kv_pool.py (the "
+        "PrefixIndex, its one sanctioned sharer, lives there). A write "
+        "to ._refs/._free/._chains/._states from anywhere else, or an "
+        ".incref()/.decref() call outside that module, bypasses the "
+        "sanitizer hooks and the allocator's own checks: the shadow "
+        "ledger and the books silently diverge, and the next "
+        "verify_quiesce blames code that was innocent. Route the "
+        "mutation through alloc_mixed/free/set_state, or add the "
+        "operation to the allocator's API surface.",
+    ),
+    RuleInfo(
+        "lifecycle-span-imbalance", "warning",
+        "swap span opened (.set_state/.swap_out_begin) without a close "
+        "on every path in the function",
+        "A function opens a swap window — .set_state() or "
+        ".swap_out_begin() — and either never closes it (.clear_state/"
+        ".swap_out_finish) anywhere in its body, or a raise after the "
+        "open can escape with no close lexically between. An open "
+        "window pins the chain: the allocator refuses to free it, so an "
+        "escaped window turns every later retire/drain of that owner "
+        "into a loud failure (or, caught carelessly, a leak). Close in "
+        "a try/finally or on the except edge (the swap_out_begin "
+        "shape). Deliberate cross-function protocols — open here, close "
+        "in the finalize step next tick — are the one sanctioned "
+        "imbalance: suppress inline with the reason, so the protocol "
+        "is recorded at the open site.",
+    ),
+]
+
+_ACQUIRE_ATTRS = {"alloc", "alloc_mixed", "_alloc_evict"}
+_PRIVATE_BOOKS = {"_refs", "_free", "_chains", "_states"}
+_REF_CALLS = {"incref", "decref"}
+_SPAN_OPENS = {"set_state", "swap_out_begin"}
+_SPAN_CLOSES = {"clear_state", "swap_out_finish"}
+
+#: the one module sanctioned to touch the private books and refcounts
+_ALLOCATOR_MODULE = "serving/kv_pool.py"
+
+
+def _call_attr(node: ast.AST) -> Optional[str]:
+    """Attribute name of a method-style call (``x.y(...)`` -> ``y``)."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _walk_no_nested(fn: ast.AST):
+    """Walk a function body without descending into nested function/
+    class definitions (their paths are not this function's paths)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---- lifecycle-alloc-leak --------------------------------------------------
+
+
+def _is_oom_guard_return(ret: ast.Return, fn: ast.FunctionDef,
+                         chain_var: Optional[str]) -> bool:
+    """True for the deterministic-OOM idiom: a return inside an
+    ``if <chain> is None:`` (or ``if not <chain>:``) block — nothing was
+    allocated on that path, so leaving is clean."""
+    if chain_var is None:
+        return False
+    for node in _walk_no_nested(fn):
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        guarded = False
+        if (
+            isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and test.left.id == chain_var
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.Eq))
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+        ):
+            guarded = True
+        elif (
+            isinstance(test, ast.UnaryOp)
+            and isinstance(test.op, ast.Not)
+            and isinstance(test.operand, ast.Name)
+            and test.operand.id == chain_var
+        ):
+            guarded = True
+        if guarded and any(n is ret for n in ast.walk(node)):
+            return True
+    return False
+
+
+def _check_alloc_leak(fn: ast.FunctionDef, mod: ParsedModule,
+                      findings: List[Finding]) -> None:
+    acquires: List[Tuple[int, Optional[str]]] = []  # (line, chain var)
+    for node in _walk_no_nested(fn):
+        if isinstance(node, ast.Assign) and _call_attr(
+                node.value) in _ACQUIRE_ATTRS:
+            var = (
+                node.targets[0].id
+                if len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name) else None
+            )
+            acquires.append((node.lineno, var))
+    if not acquires:
+        return
+    commit_lines = []    # table-row stores: self.tables[...] = ...
+    free_lines = []      # .free(...) / .release(...) calls
+    for node in _walk_no_nested(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Attribute)
+                    and t.value.attr == "tables"
+                ):
+                    commit_lines.append(node.lineno)
+        attr = _call_attr(node)
+        if attr in ("free", "release"):
+            free_lines.append(node.lineno)
+    first_commit = min(commit_lines) if commit_lines else None
+
+    for alloc_line, chain_var in acquires:
+        for node in _walk_no_nested(fn):
+            line = getattr(node, "lineno", 0)
+            if line <= alloc_line:
+                continue
+            if first_commit is not None and line > first_commit:
+                continue
+            if isinstance(node, ast.Raise):
+                # a free before this raise (the except-release shape)
+                # hands the blocks back before the edge escapes
+                if any(alloc_line < f < line for f in free_lines):
+                    continue
+                findings.append(Finding(
+                    "lifecycle-alloc-leak", "error", mod.path, line,
+                    f"{fn.name} allocates a block chain at line "
+                    f"{alloc_line} but this raise can escape before the "
+                    f"chain is committed to a table row or freed — the "
+                    f"blocks leak; free in a try/except and re-raise "
+                    f"(the import_chain shape), or record why the edge "
+                    f"is unreachable",
+                ))
+            elif isinstance(node, ast.Return):
+                if _is_oom_guard_return(node, fn, chain_var):
+                    continue  # the OOM idiom: nothing was allocated
+                if (
+                    chain_var is not None
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == chain_var
+                ):
+                    continue  # chain handed to the caller, who owns it
+                if any(alloc_line < f < line for f in free_lines):
+                    continue
+                findings.append(Finding(
+                    "lifecycle-alloc-leak", "error", mod.path, line,
+                    f"{fn.name} allocates a block chain at line "
+                    f"{alloc_line} but returns here before the chain is "
+                    f"committed to a table row or freed — the blocks "
+                    f"leak on this path",
+                ))
+
+
+# ---- lifecycle-refcount-outside-allocator ----------------------------------
+
+
+def _check_refcount_outside(mod: ParsedModule,
+                            findings: List[Finding]) -> None:
+    if mod.path.endswith(_ALLOCATOR_MODULE):
+        return
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if (
+                    isinstance(base, ast.Attribute)
+                    and base.attr in _PRIVATE_BOOKS
+                ):
+                    findings.append(Finding(
+                        "lifecycle-refcount-outside-allocator", "error",
+                        mod.path, node.lineno,
+                        f"write to allocator private book .{base.attr} "
+                        f"outside {_ALLOCATOR_MODULE} — this bypasses "
+                        f"the allocator's invariant checks and the "
+                        f"blocksan shadow hooks; route it through the "
+                        f"allocator API",
+                    ))
+        attr = _call_attr(node)
+        if attr in _REF_CALLS:
+            findings.append(Finding(
+                "lifecycle-refcount-outside-allocator", "error",
+                mod.path, node.lineno,
+                f".{attr}() called outside {_ALLOCATOR_MODULE} — "
+                f"refcount mutations belong to the allocator and its "
+                f"in-module PrefixIndex; from anywhere else they skip "
+                f"the chain/ownership bookkeeping the sanitizer and "
+                f"the free path rely on",
+            ))
+        # container mutations on the books: x._free.append(b) etc.
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr in _PRIVATE_BOOKS
+            and node.func.attr in ("append", "extend", "pop", "remove",
+                                   "clear", "update", "setdefault",
+                                   "insert", "popitem")
+        ):
+            findings.append(Finding(
+                "lifecycle-refcount-outside-allocator", "error",
+                mod.path, node.lineno,
+                f".{node.func.value.attr}.{node.func.attr}() mutates an "
+                f"allocator private book outside {_ALLOCATOR_MODULE} — "
+                f"route it through the allocator API",
+            ))
+
+
+# ---- lifecycle-span-imbalance ----------------------------------------------
+
+
+def _check_span_imbalance(fn: ast.FunctionDef, mod: ParsedModule,
+                          findings: List[Finding]) -> None:
+    opens: List[int] = []
+    closes: List[int] = []
+    raises: List[int] = []
+    for node in _walk_no_nested(fn):
+        attr = _call_attr(node)
+        if attr in _SPAN_OPENS:
+            opens.append(node.lineno)
+        elif attr in _SPAN_CLOSES:
+            closes.append(node.lineno)
+        elif isinstance(node, ast.Raise):
+            raises.append(node.lineno)
+    if not opens:
+        return
+    first_open = min(opens)
+    if not closes:
+        findings.append(Finding(
+            "lifecycle-span-imbalance", "warning", mod.path, first_open,
+            f"{fn.name} opens a swap span here and never closes it on "
+            f"any path in this function — if the close lives in another "
+            f"function (a cross-tick window protocol), suppress with "
+            f"the protocol as the reason; otherwise close in "
+            f"try/finally",
+        ))
+        return
+    for r in sorted(raises):
+        if r <= first_open:
+            continue  # pre-open guard raises hold no window yet
+        if any(first_open < c < r for c in closes):
+            continue
+        findings.append(Finding(
+            "lifecycle-span-imbalance", "warning", mod.path, r,
+            f"{fn.name} opened a swap span at line {first_open} and "
+            f"this raise can escape with the window still open — the "
+            f"chain stays pinned and every later free of its owner "
+            f"fails loudly; close on the except edge before re-raising "
+            f"(the swap_out_begin shape)",
+        ))
+
+
+def check_lifecycle(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_alloc_leak(node, mod, findings)
+            _check_span_imbalance(node, mod, findings)
+    _check_refcount_outside(mod, findings)
+    return findings
+
+
+CHECK = check_lifecycle
+CROSS_MODULE = False

@@ -21,8 +21,11 @@ it:
   held, when the same attribute is also touched by the class's
   non-thread methods. The classic shapes: a results list appended from
   the worker and read from ``summary()``, a state flag flipped on both
-  sides of a check-then-act. Deliberate lock-free protocols (monotonic
-  flags, GIL-atomic single stores) stay — with an inline suppression
+  sides of a check-then-act. Both lock idioms are credited: ``with
+  self.<lock>:`` and a bare ``self.<lock>.acquire()`` …
+  ``release()`` pair tracked lexically through the statement list (the
+  try/finally shape). Deliberate lock-free protocols (monotonic flags,
+  GIL-atomic single stores) stay — with an inline suppression
   recording WHY they are safe.
 - ``thread-blocking-signal`` (error): a blocking call —
   ``.block_until_ready()``, ``open()``/file I/O, ``time.sleep``,
@@ -63,8 +66,10 @@ RULES = [
         "shared attribute mutated from a thread without a lock held",
         "An attribute written from a threading.Thread target method (or "
         "a method it reaches through self.*() calls) while the class's "
-        "other methods also read or write it, with no `with self.<lock>:` "
-        "covering the write. The GIL serializes single bytecodes, not "
+        "other methods also read or write it, with no lock covering the "
+        "write — either `with self.<lock>:` or a bare "
+        "`self.<lock>.acquire()` ... `release()` pair around it (the "
+        "try/finally shape). The GIL serializes single bytecodes, not "
         "compound operations: check-then-append, read-modify-write "
         "(`self.n += 1`) and multi-field updates can interleave with the "
         "main thread and corrupt or drop state. Hold the class's lock "
@@ -244,10 +249,39 @@ class _ClassView:
                     out.setdefault(attr, set()).add(name)
         return out
 
+    def _lock_toggle(self, stmt: ast.stmt) -> Optional[str]:
+        """"acquire"/"release" for a bare ``self.<lock>.acquire()`` /
+        ``.release()`` expression statement, else None."""
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            f = stmt.value.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("acquire", "release")
+                and _self_attr(f.value) in self.lock_attrs
+            ):
+                return f.attr
+        return None
+
     def mutations_in(self, method: ast.FunctionDef):
         """(attr, line, locked) for every self-attr mutation in the
-        method, with ``locked`` True when under any `with self.<lock>:`."""
+        method. ``locked`` is True under a ``with self.<lock>:`` OR
+        lexically between a bare ``self.<lock>.acquire()`` statement and
+        its ``release()`` in the same statement list — the try/finally
+        shape host-worker code uses when the critical section spans a
+        handler edge the context manager cannot express."""
         out: List[Tuple[str, int, bool]] = []
+
+        def visit_block(stmts, locked: bool):
+            # sequential lock tracking: a bare acquire() statement
+            # covers the rest of this list (a following try's body and
+            # finally included) until the matching release()
+            held = locked
+            for stmt in stmts:
+                toggle = self._lock_toggle(stmt)
+                if toggle is not None:
+                    held = locked or toggle == "acquire"
+                    continue
+                visit(stmt, held)
 
         def visit(node: ast.AST, locked: bool):
             if isinstance(node, (ast.With, ast.AsyncWith)):
@@ -256,8 +290,23 @@ class _ClassView:
                     and attr in self.lock_attrs
                     for item in node.items
                 )
-                for sub in node.body:
-                    visit(sub, holds)
+                visit_block(node.body, holds)
+                return
+            if isinstance(node, ast.Try):
+                visit_block(node.body, locked)
+                for h in node.handlers:
+                    visit_block(h.body, locked)
+                visit_block(node.orelse, locked)
+                visit_block(node.finalbody, locked)
+                return
+            if isinstance(node, (ast.If, ast.For, ast.AsyncFor,
+                                 ast.While)):
+                for field in ("test", "iter", "target"):
+                    sub = getattr(node, field, None)
+                    if sub is not None:
+                        visit(sub, locked)
+                visit_block(node.body, locked)
+                visit_block(node.orelse, locked)
                 return
             if isinstance(node, ast.Assign):
                 for t in node.targets:
@@ -290,8 +339,7 @@ class _ClassView:
                     continue
                 visit(child, locked)
 
-        for stmt in method.body:
-            visit(stmt, False)
+        visit_block(method.body, False)
         return out
 
 

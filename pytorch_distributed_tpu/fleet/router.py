@@ -149,6 +149,13 @@ class FleetRouter:
             )
 
             self.host_pool = HostWorkerPool(n_threads=host_threads)
+        # block-lifecycle sanitizer (analysis.blocksan; PDT_BLOCKSAN=1):
+        # ONE sanitizer shared by every replica, so handoff pins and
+        # violations aggregate fleet-wide and one assert_clean() covers
+        # the whole pool population. None (the default) end to end.
+        from pytorch_distributed_tpu.analysis.blocksan import maybe_sanitizer
+
+        self.blocksan = maybe_sanitizer(metrics_log=metrics_log)
         self.replicas: List[Scheduler] = []
         self.roles: List[str] = []
         for i in range(n_replicas):
@@ -176,7 +183,7 @@ class FleetRouter:
                 handoff=disaggregate, metrics_log=metrics_log,
                 tracer=tracer, flightrec=self.flightrec,
                 reqtrace=self.reqtrace, ledger=self.ledger,
-                host_pool=self.host_pool, **kw,
+                host_pool=self.host_pool, blocksan=self.blocksan, **kw,
             ))
             self.roles.append(role)
         self.disaggregated = disaggregate
@@ -443,11 +450,27 @@ class FleetRouter:
                     for s in self.replicas:
                         s.flush_host_work()
                     self.host_pool.flush()
+                if self.blocksan is not None:
+                    # fleet quiesce: every replica's ledger must equal
+                    # its allocator with no chains, swap windows, or
+                    # handoff pins outstanding (the drain retired or
+                    # adopted everything; index-retained blocks are
+                    # legitimately live)
+                    for s in self.replicas:
+                        if s._san is not None:
+                            s._san.verify_quiesce()
                 return dict(self.results)
             self.step()
         raise RuntimeError(
             f"fleet drain did not converge within {max_steps} steps"
         )
+
+    def cancel(self, rid: int, reason: str = "client-cancel") -> bool:
+        """Fleet cancellation: abort ``rid`` on whichever replica holds
+        it (queued, resident, parked, mid-swap, or handoff-ready).
+        Returns False when no replica knows the rid — already retired,
+        shed, or never submitted; cancellation is idempotent."""
+        return any(s.cancel(rid, reason=reason) for s in self.replicas)
 
     # ---- compile-cache integration ----
 
@@ -552,6 +575,9 @@ class FleetRouter:
             ),
             "affinity_sessions": len(self._affinity),
             "affinity_evictions": self._affinity_evictions,
+            "cancelled": sum(m["cancelled"] for m in per),
+            **(self.blocksan.summary()
+               if self.blocksan is not None else {}),
             "recommended_replicas": self.recommend_replicas(),
             "recommended_replicas_peak": self._recommend_peak,
             "async_host": self.async_host,

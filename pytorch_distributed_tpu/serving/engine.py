@@ -32,6 +32,7 @@ every shard).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -699,6 +700,13 @@ class PagedEngine:
         return blocks_needed(prompt_len, max_new_tokens, self.block_len,
                              self.chunk)
 
+    def _san_site(self, label: str):
+        """Label the allocator ops inside the ``with`` block for the
+        block-lifecycle sanitizer's ledger (``analysis.blocksan``,
+        ``PDT_BLOCKSAN=1``); a no-op context when detached."""
+        san = self.allocator.sanitizer
+        return san.site(label) if san is not None else contextlib.nullcontext()
+
     def set_kv_trace(self, observer) -> None:
         """Install ``observer(event, owner, info)`` on this engine's
         block allocator (``BlockAllocator.on_transition``): every chain
@@ -736,7 +744,8 @@ class PagedEngine:
                 f"{self.table_width} (max_seq_len {self.config.max_seq_len}"
                 f" / block_len {self.block_len})"
             )
-        chain = self._alloc_evict(slot, [], need)
+        with self._san_site("admit"):
+            chain = self._alloc_evict(slot, [], need)
         if chain is None:
             return False
         self.tables[slot] = TRASH_BLOCK
@@ -804,8 +813,9 @@ class PagedEngine:
         need = blocks_needed_suffix(covered, prompt_len, max_new_tokens,
                                     bl, c)
         evicted0 = self.prefix.evictions
-        chain = self._alloc_evict(slot, matched[:n_shared],
-                                  need - n_shared)
+        with self._san_site("admit-shared"):
+            chain = self._alloc_evict(slot, matched[:n_shared],
+                                      need - n_shared)
         if chain is None:
             return None
         self.tables[slot] = TRASH_BLOCK
@@ -822,6 +832,9 @@ class PagedEngine:
                     jnp.asarray(chain[n_shared], jnp.int32),
                 )
             self._cow_copies += 1
+            if self.allocator.sanitizer is not None:
+                self.allocator.sanitizer.note_cow(
+                    slot, matched[n_shared], chain[n_shared])
         return PrefixHit(
             covered=covered, shared=n_shared, cow=cow,
             evicted=self.prefix.evictions - evicted0,
@@ -860,7 +873,8 @@ class PagedEngine:
         """Free the slot's chain and point its table row at the trash
         block, so the shared decode program's garbage writes for this
         (now inactive) lane can never touch recycled blocks."""
-        self.allocator.free(slot)
+        with self._san_site("release"):
+            self.allocator.free(slot)
         self.tables[slot] = TRASH_BLOCK
 
     def release_all(self) -> None:
@@ -970,26 +984,37 @@ class PagedEngine:
                 f"cannot import block_len={export.block_len} blocks into "
                 f"a block_len={self.block_len} pool"
             )
-        chain = self._alloc_evict(slot, [], export.n_blocks)
+        with self._san_site("handoff-import"):
+            chain = self._alloc_evict(slot, [], export.n_blocks)
         if chain is None:
             return False
         n_pad = self._chain_bucket(export.n_blocks)
         idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
         idx[:export.n_blocks] = chain
-        # the explicit block-transfer step (a no-op view when source and
-        # target share a device). Padding lanes scatter into the trash
-        # block, which absorbs anything.
-        blocks = jax.tree.map(
-            lambda b, pool: jax.device_put(b, pool.sharding),
-            export.blocks, self.cache,
-        )
-        row = jax.device_put(export.logits_row, self.logits.sharding)
-        with self.ledger.launch(self.ledger_replica,
-                                self.import_program_name(n_pad)):
-            self.cache, self.logits = self._import_fn(n_pad)(
-                self.cache, self.logits, blocks, jnp.asarray(idx),
-                jnp.asarray(slot, jnp.int32), row,
+        try:
+            # the explicit block-transfer step (a no-op view when source
+            # and target share a device). Padding lanes scatter into the
+            # trash block, which absorbs anything.
+            blocks = jax.tree.map(
+                lambda b, pool: jax.device_put(b, pool.sharding),
+                export.blocks, self.cache,
             )
+            row = jax.device_put(export.logits_row, self.logits.sharding)
+            with self.ledger.launch(self.ledger_replica,
+                                    self.import_program_name(n_pad)):
+                self.cache, self.logits = self._import_fn(n_pad)(
+                    self.cache, self.logits, blocks, jnp.asarray(idx),
+                    jnp.asarray(slot, jnp.int32), row,
+                )
+        except BaseException:
+            # the fresh chain was allocated but never committed to the
+            # table: free it, or a failed cross-device transfer leaks
+            # the whole chain (blocksan: leak-at-retire). The export is
+            # untouched — the caller's retry contract holds.
+            with self._san_site("handoff-import"):
+                self.allocator.free(slot)
+            self.tables[slot] = TRASH_BLOCK
+            raise
         self.tables[slot] = TRASH_BLOCK
         self.tables[slot, :export.n_blocks] = chain
         return True
@@ -1059,22 +1084,31 @@ class PagedEngine:
         chain = self.allocator.chain(slot)
         if not chain:
             raise ValueError(f"slot {slot} holds no block chain to swap")
-        self.allocator.set_state(slot, SWAPPING_OUT)
-        n_pad = self._chain_bucket(len(chain))
-        idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
-        idx[:len(chain)] = chain
-        with self.ledger.launch(self.ledger_replica,
-                                self.swap_out_program_name(n_pad)) as lt:
-            blocks, row = self._swap_out_fn(n_pad)(
-                self.cache, self.logits, jnp.asarray(idx),
-                jnp.asarray(slot, jnp.int32),
-            )
-            lt.handle = row  # pure-read output: safe to fence lagged
-        for leaf in jax.tree.leaves(blocks) + [row]:
-            try:
-                leaf.copy_to_host_async()  # overlap d2h with serving
-            except AttributeError:
-                pass
+        with self._san_site("swap-out"):
+            self.allocator.set_state(slot, SWAPPING_OUT)
+        try:
+            n_pad = self._chain_bucket(len(chain))
+            idx = np.full((n_pad,), TRASH_BLOCK, np.int32)
+            idx[:len(chain)] = chain
+            with self.ledger.launch(self.ledger_replica,
+                                    self.swap_out_program_name(n_pad)) as lt:
+                blocks, row = self._swap_out_fn(n_pad)(
+                    self.cache, self.logits, jnp.asarray(idx),
+                    jnp.asarray(slot, jnp.int32),
+                )
+                lt.handle = row  # pure-read output: safe to fence lagged
+            for leaf in jax.tree.leaves(blocks) + [row]:
+                try:
+                    leaf.copy_to_host_async()  # overlap d2h with serving
+                except AttributeError:
+                    pass
+        except BaseException:
+            # a gather failure must not strand the slot inside an open
+            # swap window — the allocator would then refuse every later
+            # free of this chain (blocksan: pinned-block at retire)
+            with self._san_site("swap-out"):
+                self.allocator.clear_state(slot)
+            raise
         return PendingSwap(slot=slot, chain_len=len(chain), blocks=blocks,
                            logits_row=row)
 
@@ -1114,10 +1148,12 @@ class PagedEngine:
                 )
         except BaseException:
             # window closed, chain untouched: the stream stays resident
-            self.allocator.clear_state(slot)
+            with self._san_site("swap-out"):
+                self.allocator.clear_state(slot)
             raise
-        self.allocator.clear_state(slot)
-        self.release(slot)
+        with self._san_site("swap-out"):
+            self.allocator.clear_state(slot)
+            self.release(slot)
         return chain
 
     def swap_in_chain(self, slot: int, chain: HostChain) -> bool:
@@ -1138,7 +1174,8 @@ class PagedEngine:
                 f"cannot swap block_len={chain.block_len} blocks into "
                 f"a block_len={self.block_len} pool"
             )
-        ids = self._alloc_evict(slot, [], chain.n_blocks)
+        with self._san_site("swap-in"):
+            ids = self._alloc_evict(slot, [], chain.n_blocks)
         if ids is None:
             return False
         self.allocator.set_state(slot, SWAPPING_IN)
@@ -1164,11 +1201,13 @@ class PagedEngine:
                     jnp.asarray(slot, jnp.int32), row,
                 )
         except BaseException:
-            self.allocator.clear_state(slot)
-            self.allocator.free(slot)
+            with self._san_site("swap-in"):
+                self.allocator.clear_state(slot)
+                self.allocator.free(slot)
             self.tables[slot] = TRASH_BLOCK
             raise
-        self.allocator.clear_state(slot)
+        with self._san_site("swap-in"):
+            self.allocator.clear_state(slot)
         self.tables[slot] = TRASH_BLOCK
         self.tables[slot, :chain.n_blocks] = ids
         return True

@@ -163,6 +163,13 @@ class BlockAllocator:
         #: scheduler installs an adapter mapping owner slot → rid). Must
         #: never raise into the allocator; observers are forensics.
         self.on_transition: Optional[Callable[[str, int, dict], None]] = None
+        #: optional block-lifecycle sanitizer shadow
+        #: (``analysis.blocksan``; installed by ``BlockSanitizer.attach``
+        #: under ``PDT_BLOCKSAN=1``). Unlike ``on_transition`` it also
+        #: sees every incref/decref, BEFORE the allocator's own checks,
+        #: so a double free / pinned free is recorded even though the
+        #: call still raises. ``None`` costs one attribute test per op.
+        self.sanitizer = None
 
     def _notify(self, event: str, owner: int, **info) -> None:
         if self.on_transition is not None:
@@ -204,12 +211,16 @@ class BlockAllocator:
                 f"owner {owner} holds no chain to mark {state}"
             )
         self._states[owner] = state
+        if self.sanitizer is not None:
+            self.sanitizer.on_state(owner, state)
         self._notify("state", owner, state=state,
                      n_blocks=len(self._chains[owner]))
 
     def clear_state(self, owner: int) -> None:
         """Close the swap window (back to resident). Idempotent."""
         if self._states.pop(owner, None) is not None:
+            if self.sanitizer is not None:
+                self.sanitizer.on_state(owner, None)
             self._notify("state", owner, state=RESIDENT,
                          n_blocks=len(self._chains.get(owner, ())))
 
@@ -258,6 +269,8 @@ class BlockAllocator:
         self.shared_reused += len(shared)
         chain = list(shared) + fresh
         self._chains[owner] = chain
+        if self.sanitizer is not None:
+            self.sanitizer.on_alloc(owner, list(shared), list(fresh))
         self._notify("alloc", owner, n_blocks=len(chain),
                      shared=len(shared), free=len(self._free))
         return list(chain)
@@ -269,6 +282,8 @@ class BlockAllocator:
     def incref(self, block: int) -> None:
         """Add one reference to a LIVE block — the PrefixIndex's claim
         on a block it retains past its chain's free."""
+        if self.sanitizer is not None:
+            self.sanitizer.on_incref(block)
         if block not in self._refs:
             raise ValueError(f"incref of dead block {block}")
         self._refs[block] += 1
@@ -278,6 +293,8 @@ class BlockAllocator:
         list (True). Decref of a dead block is a DOUBLE FREE and raises
         — the invariant that makes shared-block recycling impossible to
         get silently wrong."""
+        if self.sanitizer is not None:
+            self.sanitizer.on_decref(block)
         n = self._refs.get(block)
         if n is None:
             raise RuntimeError(
@@ -309,6 +326,8 @@ class BlockAllocator:
         that lets a preempted chain leave without dragging shared
         prefix blocks."""
         state = self._states.get(owner)
+        if self.sanitizer is not None:
+            self.sanitizer.on_free(owner, state)
         if state is not None:
             raise RuntimeError(
                 f"owner {owner}'s chain is {state}: finish or abort the "

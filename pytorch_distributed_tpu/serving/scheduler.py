@@ -249,7 +249,7 @@ class Scheduler:
                  host_store=None,
                  host_store_max_bytes: Optional[int] = None,
                  reqtrace=None, ledger=None, host_pool=None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, blocksan=None):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
         from pytorch_distributed_tpu.serving.kv_pool import HostBlockStore
 
@@ -382,6 +382,26 @@ class Scheduler:
         self._slot2rid: Dict[int, int] = {}
         if self.reqtrace.enabled:
             self.engine.set_kv_trace(self._kv_transition)
+        # ---- block-lifecycle sanitizer (analysis.blocksan; round 18) ----
+        # PDT_BLOCKSAN=1 installs a shadow ledger on the allocator; a
+        # fleet router passes ONE sanitizer shared across replicas so
+        # handoff pins and violations aggregate. Off (the default) this
+        # is None end to end — the allocator hot path pays a single
+        # attribute test per op.
+        if blocksan is None:
+            from pytorch_distributed_tpu.analysis.blocksan import (
+                maybe_sanitizer,
+            )
+            blocksan = maybe_sanitizer(metrics_log=metrics_log,
+                                       replica_id=replica_id)
+        self.blocksan = blocksan
+        self._san = (
+            blocksan.attach(self.engine.allocator,
+                            name=f"replica{replica_id}",
+                            resolve_rid=self._slot2rid.get)
+            if blocksan is not None else None
+        )
+        self._cancelled = 0
         # host–device overlap ledger (round 15; telemetry/overlap.py):
         # the engine reports every compiled launch through it, and the
         # host marks below (admission, JSONL emit, swap decision) are
@@ -790,7 +810,7 @@ class Scheduler:
                     rid, "swap_out", parent=req.span_preempt,
                     replica=self.replica_id,
                 )
-            pending = self.engine.swap_out_begin(slot)
+            pending = self.engine.swap_out_begin(slot)  # jaxlint: disable=lifecycle-span-imbalance -- cross-tick window protocol: the span closes in _finalize_swaps at the top of the next step() (and in begin_drain), never in this function; _swap_slots tracks the open window meanwhile
             del self.resident[slot]
             self.remaining[slot] = 0
             self._swap_slots.add(slot)
@@ -1126,6 +1146,12 @@ class Scheduler:
                         req.span_prefill = 0
                     if self.prefill_only:
                         self.ready[req.rid] = j.slot
+                        if self._san is not None:
+                            # the chain is promised to a decode replica:
+                            # freeing it before complete_handoff is a
+                            # pinned-block violation only the sanitizer
+                            # can see (the allocator has no pin notion)
+                            self._san.pin(j.slot, "handoff")
                         if self.reqtrace.enabled:
                             req.span_ready = self.reqtrace.begin(
                                 req.rid, "handoff_wait",
@@ -1293,6 +1319,9 @@ class Scheduler:
                 self.remaining[slot] = 0
                 del self.resident[slot]
                 self.engine.release(slot)
+                if self._san is not None:
+                    self._san.check_retire(slot, rid=req.rid,
+                                           site="retire")
                 self._completed += 1
                 if req.cold:
                     self._cold_requests += 1
@@ -1314,6 +1343,12 @@ class Scheduler:
                 self.remaining[slot] -= 1
         if out:
             self.tick_lat.observe(now - h.t_step0)
+        if self._san is not None:
+            # use-after-free sweep: every id the decode program can read
+            # next tick must be ledger-live (the trash row aside)
+            from pytorch_distributed_tpu.serving.kv_pool import TRASH_BLOCK
+            self._san.check_tables(self.engine.tables,
+                                   trash_block=TRASH_BLOCK)
         self._observe_tick(h.t_step0)
 
     def step(self) -> List[Tuple[int, int]]:
@@ -1509,6 +1544,12 @@ class Scheduler:
                 and all(r.rid in self.ready
                         for r in self.resident.values())
             ):
+                if self._san is not None and not self.ready:
+                    # the documented post-condition, proven: ledger ≡
+                    # allocator, no chains/windows/pins outstanding.
+                    # (With chains still pinned in ``ready`` the router
+                    # quiesces after completing the handoffs instead.)
+                    self._san.verify_quiesce()
                 return produced, requeued
             for rid, tok in self.step():
                 produced.setdefault(rid, []).append(tok)
@@ -1516,6 +1557,81 @@ class Scheduler:
             f"drain_graceful did not converge within {max_steps} steps "
             f"(resident={len(self.resident)})"
         )
+
+    # ---- client cancellation (ROADMAP item 5's first rung) ----
+
+    def cancel(self, rid: int, reason: str = "client-cancel") -> bool:
+        """Abort request ``rid`` wherever it lives — queued, resident
+        (mid-prefill or decoding), parked (either restore path), mid
+        swap-out, or handoff-ready — freeing every resource it holds:
+        device chain, host-store chain, slot, handoff pin. Closes the
+        request's span tree with ``outcome="cancelled"``. Returns True
+        when the rid was found (False: already retired or unknown — a
+        benign race, cancellation is idempotent).
+
+        The blocksan cancellation-storm trace rides this path: after a
+        storm over every lifecycle state, the ledger must equal the
+        allocator with zero leaked blocks."""
+        # an in-flight tick may be decoding the victim: collect first so
+        # the chain release cannot race the launched program
+        self._collect_pending_tick()
+        for i, req in enumerate(self.queue):
+            if req.rid == rid:
+                del self.queue[i]
+                self._finish_cancel(req, slot=None, reason=reason)
+                return True
+        if any(entry[0] == rid for entry in self._swapping):
+            # close the open d2h window first: the chain either commits
+            # to the host store (cancel the parked copy below) or
+            # reverts to resident (release the chain below) — never
+            # freed mid-window
+            self._finalize_swaps()
+        if rid in self.parked:
+            req, path = self.parked.pop(rid)
+            if path == "swap":
+                self.host_store.pop(rid)
+            self._finish_cancel(req, slot=None, reason=reason)
+            return True
+        slot = next(
+            (s for s, r in self.resident.items() if r.rid == rid), None
+        )
+        if slot is None:
+            return False
+        req = self.resident.pop(slot)
+        self.ready.pop(rid, None)
+        if self._san is not None:
+            self._san.unpin(slot)
+        self.remaining[slot] = 0
+        self.engine.release(slot)
+        self._slot2rid.pop(slot, None)
+        if self._san is not None:
+            self._san.check_retire(slot, rid=rid, site="cancel")
+        self._finish_cancel(req, slot=slot, reason=reason)
+        return True
+
+    def _finish_cancel(self, req: Request, slot: Optional[int],
+                       reason: str) -> None:
+        """Shared cancellation tail: counters, flight record, span-tree
+        closure (every open span ends, then the root, all with
+        ``outcome="cancelled"``)."""
+        self._cancelled += 1
+        self.flightrec.record(
+            "cancel", rid=req.rid, reason=reason,
+            slot=slot if slot is not None else -1,
+            tokens=req.produced, replica=self.replica_id,
+        )
+        if self.reqtrace.enabled:
+            for name in ("span_decode", "span_prefill", "span_ready",
+                         "span_swap", "span_parked", "span_preempt",
+                         "span_queue"):
+                sid = getattr(req, name)
+                if sid:
+                    self.reqtrace.end(sid, outcome="cancelled")
+                    setattr(req, name, 0)
+            self.reqtrace.end(
+                self.reqtrace.root(req.rid), outcome="cancelled",
+                new_tokens=req.produced, reason=reason,
+            )
 
     # ---- prefill→decode handoff (fleet disaggregation) ----
 
@@ -1539,7 +1655,11 @@ class Scheduler:
         if self.reqtrace.enabled:
             self.reqtrace.end(req.span_ready)
             req.span_ready = 0
+        if self._san is not None:
+            self._san.unpin(slot)  # adoption committed: free is legal now
         self.engine.release(slot)
+        if self._san is not None:
+            self._san.check_retire(slot, rid=rid, site="handoff-complete")
         self.remaining[slot] = 0
         self._handoffs += 1
 
@@ -1712,6 +1832,9 @@ class Scheduler:
             ),
             "admitted": self._admitted,
             "completed": self._completed,
+            "cancelled": self._cancelled,
+            **(self.blocksan.summary()
+               if self.blocksan is not None else {}),
             "tokens_out": self._tokens_out,
             "tokens_per_s": self._tokens_out / elapsed if elapsed else 0.0,
             "admission_latency_steps_mean": (

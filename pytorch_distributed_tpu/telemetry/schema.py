@@ -64,6 +64,9 @@ REQUIRED_KEYS: Dict[str, FrozenSet[str]] = {
     "serving_summary": frozenset({"tokens_out", "completed"}),
     # compilecache/warmup.py per-program manifest
     "warmup": frozenset({"program", "seconds", "cache_hit"}),
+    # analysis/blocksan.py block-lifecycle sanitizer (round 18);
+    # per-``ev`` shapes refined by ``_SANITIZER_EV_KEYS`` below
+    "sanitizer": frozenset({"ev", "shadow", "replica_id"}),
 }
 
 #: additional required keys per span ``ev`` (see reqtrace module docs)
@@ -82,6 +85,12 @@ _OVERLAP_EV_KEYS: Dict[str, FrozenSet[str]] = {
     "summary": frozenset({"launches", "busy_s", "span_s", "busy_frac"}),
 }
 
+#: additional required keys per sanitizer ``ev`` (analysis/blocksan.py)
+_SANITIZER_EV_KEYS: Dict[str, FrozenSet[str]] = {
+    "violation": frozenset({"class", "block", "owner", "site"}),
+    "quiesce": frozenset({"ok", "live_blocks", "violations"}),
+}
+
 
 def validate_record(record: dict, strict: bool = False) -> List[str]:
     """Errors for one record (empty list == conformant). ``strict``
@@ -97,7 +106,8 @@ def validate_record(record: dict, strict: bool = False) -> List[str]:
         for k in sorted(required) if k not in record
     ]
     for refined, table in (("span", _SPAN_EV_KEYS),
-                           ("overlap", _OVERLAP_EV_KEYS)):
+                           ("overlap", _OVERLAP_EV_KEYS),
+                           ("sanitizer", _SANITIZER_EV_KEYS)):
         if kind != refined:
             continue
         ev = record.get("ev")

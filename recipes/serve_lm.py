@@ -373,6 +373,10 @@ def main() -> None:
                 router.step,
                 lambda: router.idle,
             )
+            # the fleet is idle here, so this runs only the drain
+            # epilogue: the host-work flush barrier and (under
+            # PDT_BLOCKSAN=1) the fleet-wide ledger quiesce check
+            router.drain()
         else:
             for i, p in enumerate(prompts):
                 router.submit(p, args.max_new, session=i % 8)

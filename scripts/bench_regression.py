@@ -22,9 +22,20 @@ disk-weather swing, PERF_NOTES §8) — a regression there must be
 structural, not meteorological. Override any band with
 ``--band key=frac`` (repeatable).
 
+``--blocksan-off`` is a separate structural gate (round 18): with
+``PDT_BLOCKSAN`` unset, the block-lifecycle sanitizer must be fully
+detached — ``maybe_sanitizer()`` returns None and a fresh
+``BlockAllocator`` carries ``sanitizer=None``, so every hook site in the
+hot alloc/free path costs one attribute load + is-None branch and the
+bench numbers above measure the same code the seed measured. It also
+micro-times alloc/free cycles detached vs attached (informational, with
+a generous flake-proof bound) and exits non-zero if the detached path is
+somehow slower than the attached one.
+
 Usage:
     python scripts/bench_regression.py CURRENT.json PREVIOUS.json [--json]
     python scripts/bench_regression.py --auto [--dir .]   # two newest rounds
+    python scripts/bench_regression.py --blocksan-off [--json]
 """
 
 from __future__ import annotations
@@ -192,6 +203,59 @@ def newest_rounds(directory: str) -> Tuple[str, str]:
     return rounds[-1], rounds[-2]
 
 
+def blocksan_off_nil(emit_json: bool = False) -> int:
+    """The blocksan-off overhead gate: prove the sanitizer is detached
+    when ``PDT_BLOCKSAN`` is unset (structural nil — each hook site is a
+    single is-None branch) and that detached alloc/free cycles are not
+    slower than attached ones (generous 1.5x bound: timing is
+    informational, the structural checks are the gate)."""
+    import time as _time
+
+    os.environ.pop("PDT_BLOCKSAN", None)
+    sys.path.insert(0, REPO_DEFAULT)
+    from pytorch_distributed_tpu.analysis.blocksan import (
+        BlockSanitizer, maybe_sanitizer,
+    )
+    from pytorch_distributed_tpu.serving.kv_pool import BlockAllocator
+
+    assert maybe_sanitizer() is None, \
+        "PDT_BLOCKSAN unset but maybe_sanitizer() armed a sanitizer"
+    alloc = BlockAllocator(n_blocks=64)
+    assert alloc.sanitizer is None, \
+        "fresh BlockAllocator arrived with a sanitizer attached"
+
+    def cycles(a, n=2000):
+        t0 = _time.perf_counter()
+        for i in range(n):
+            a.alloc(1, 4)
+            a.free(1)
+        return (_time.perf_counter() - t0) / n * 1e9  # ns per cycle
+
+    cycles(alloc, 200)  # warm both paths before timing
+    off_ns = cycles(alloc)
+    san = BlockSanitizer()
+    san.attach(alloc, name="bench")
+    cycles(alloc, 200)
+    on_ns = cycles(alloc)
+    san.assert_clean()
+    row = {
+        "blocksan_off_ns_per_cycle": round(off_ns),
+        "blocksan_on_ns_per_cycle": round(on_ns),
+        "blocksan_off_detached": True,
+    }
+    print(f"blocksan-off: detached (structural nil), "
+          f"{row['blocksan_off_ns_per_cycle']} ns/cycle off vs "
+          f"{row['blocksan_on_ns_per_cycle']} ns/cycle on")
+    if emit_json:
+        print(json.dumps(row))
+    if off_ns > on_ns * 1.5:
+        print(f"blocksan-off: detached path SLOWER than attached "
+              f"({off_ns:.0f} ns vs {on_ns:.0f} ns) — hook sites are "
+              f"doing work while detached", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("paths", nargs="*",
@@ -203,8 +267,14 @@ def main(argv=None) -> int:
                    metavar="KEY=FRAC", help="override one key's band")
     p.add_argument("--json", action="store_true",
                    help="append the comparison as one JSON line")
+    p.add_argument("--blocksan-off", action="store_true",
+                   help="assert the block-lifecycle sanitizer is fully "
+                        "detached (nil overhead) when PDT_BLOCKSAN is "
+                        "unset, then exit")
     args = p.parse_args(argv)
 
+    if args.blocksan_off:
+        return blocksan_off_nil(emit_json=args.json)
     if args.auto:
         cur_path, prev_path = newest_rounds(args.dir)
     elif len(args.paths) == 2:

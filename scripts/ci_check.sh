@@ -11,7 +11,8 @@
 #                             --fleet-smoke|--obs-smoke|--kernel-smoke|
 #                             --pressure-smoke|--trace-smoke|
 #                             --overlap-smoke|--async-smoke|
-#                             --prefix-smoke|--bench-regression]
+#                             --prefix-smoke|--blocksan-smoke|
+#                             --bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -111,6 +112,14 @@
 # streams across the A/B; then telemetry_report.py must render the
 # prefix section (--require prefix: hit rate, covered fraction, COW
 # count) from the ON run's JSONL alone (~40 s).
+#
+# --blocksan-smoke: lint, then the round-18 block-lifecycle sanitizer
+# cycle: one short disaggregated serve under PDT_BLOCKSAN=1 (preempt +
+# swap so the trace crosses admit/prefix-share/COW/swap/restore/handoff/
+# retire), then the SAME serve with an injected kv.swap_out_d2h fault —
+# both runs' JSONLs must carry kind="sanitizer" quiesce records with
+# ok=true and ZERO violation records (the shadow ledger matched the
+# allocator even through the fault) (~40 s).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -379,9 +388,50 @@ PY
     exit 0
 fi
 
+if [[ "${1:-}" == "--blocksan-smoke" ]]; then
+    echo "== blocksan smoke (PDT_BLOCKSAN=1 serve, clean + faulted -> ledger ok) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py \
+        --gen-trace "$smoke/trace.jsonl" --trace-duration 30 \
+        --trace-base-rate 0.7 --trace-prompt-max 88
+    # clean pass: disagg + preempt/swap so the ledger sees every
+    # lifecycle edge (alloc, share, COW, swap-out/in, handoff, retire)
+    JAX_PLATFORMS=cpu PDT_BLOCKSAN=1 python recipes/serve_lm.py --tiny \
+        --replicas 2 --disaggregate --slots 4 --n-blocks 13 --max-new 8 \
+        --preempt --swap-policy swap --trace "$smoke/trace.jsonl" \
+        --metrics-out "$smoke/blocksan.jsonl"
+    # faulted pass: first swap-out D2H gather dies mid-window — the
+    # revert path must leave the ledger just as clean
+    JAX_PLATFORMS=cpu PDT_BLOCKSAN=1 \
+        PDT_FAULT_PLAN='{"faults":[{"site":"kv.swap_out_d2h","kind":"raise","at":1}]}' \
+        python recipes/serve_lm.py --tiny \
+        --replicas 2 --disaggregate --slots 4 --n-blocks 13 --max-new 8 \
+        --preempt --swap-policy swap --trace "$smoke/trace.jsonl" \
+        --metrics-out "$smoke/blocksan_fault.jsonl"
+    python - "$smoke/blocksan.jsonl" "$smoke/blocksan_fault.jsonl" <<'PY'
+import json, sys
+for path in sys.argv[1:]:
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    san = [r for r in rows if r.get("kind") == "sanitizer"]
+    bad = [r for r in san if r["ev"] == "violation"]
+    quiesce = [r for r in san if r["ev"] == "quiesce"]
+    assert not bad, f"{path}: blocksan violations: {bad}"
+    assert quiesce, f"{path}: no quiesce record — sanitizer never armed"
+    assert all(q["ok"] for q in quiesce), quiesce
+    print(f"{path.rsplit('/', 1)[-1]}: {len(quiesce)} quiesce record(s) "
+          f"ok, 0 violations")
+PY
+    echo "blocksan smoke OK"
+    exit 0
+fi
+
 if [[ "${1:-}" == "--bench-regression" ]]; then
     echo "== bench regression (newest round vs previous, noise-banded) =="
     python scripts/bench_regression.py --auto --json
+    # round 18: the bench numbers are only comparable if the sanitizer
+    # really is detached when PDT_BLOCKSAN is unset
+    JAX_PLATFORMS=cpu python scripts/bench_regression.py --blocksan-off
     exit 0
 fi
 

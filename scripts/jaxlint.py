@@ -5,6 +5,7 @@
     python scripts/jaxlint.py --list-rules
     python scripts/jaxlint.py --explain donation-use-after-donate
     python scripts/jaxlint.py --incremental pytorch_distributed_tpu/
+    python scripts/jaxlint.py --changed pytorch_distributed_tpu/ scripts/
     python scripts/jaxlint.py --sarif-out output/jaxlint.sarif pytorch_distributed_tpu/
     python scripts/jaxlint.py --fix-baseline pytorch_distributed_tpu/
     python scripts/jaxlint.py --no-baseline tests/fixtures/jaxlint/
@@ -19,7 +20,11 @@ deterministic order, preserving reasons and dropping fixed entries — the
 baseline only ever shrinks. --incremental serves unchanged files from a
 content-hash cache (cross-module rules still re-run on any change). The
 partition-coverage check needs an importable jax and is skipped with a
-notice when that fails (e.g. a docs-only CI container).
+notice when that fails (e.g. a docs-only CI container). --changed
+narrows the given paths to the .py files that differ from
+``git merge-base HEAD main`` (tracked edits plus untracked files) — the
+fast pre-push mode; it falls back to a full lint with a notice when git
+or the main branch is unavailable, and exits 0 when nothing changed.
 
 Rules and the suppression syntax are documented in ANALYSIS.md; the
 long-form text behind --explain lives next to each rule's implementation
@@ -53,6 +58,47 @@ DEFAULT_BASELINE = os.path.join(REPO, "scripts", "jaxlint_baseline.json")
 DEFAULT_CACHE = os.path.join(REPO, ".jaxlint_cache.json")
 
 
+def _changed_files(paths):
+    """Resolve --changed: absolute .py paths under *paths* that differ
+    from ``git merge-base HEAD main`` (tracked diffs plus untracked
+    files). Returns ``(files, error)`` — on any git failure ``files`` is
+    None and ``error`` says why, so the caller can fall back to a full
+    lint rather than silently passing an unlinted tree."""
+    import subprocess
+
+    def _git(*cmd):
+        res = subprocess.run(
+            ["git", *cmd], capture_output=True, text=True, cwd=REPO,
+            timeout=30,
+        )
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(cmd)}: {res.stderr.strip() or 'failed'}"
+            )
+        return res.stdout
+
+    try:
+        base = _git("merge-base", "HEAD", "main").strip()
+        rels = _git("diff", "--name-only", base).splitlines()
+        rels += _git(
+            "ls-files", "--others", "--exclude-standard"
+        ).splitlines()
+    except (OSError, RuntimeError, subprocess.SubprocessError) as e:
+        return None, str(e)
+    roots = [os.path.abspath(p) for p in paths]
+    files = []
+    for rel in dict.fromkeys(rels):  # dedupe, keep order
+        if not rel.endswith(".py"):
+            continue
+        abspath = os.path.join(REPO, rel)
+        if not os.path.exists(abspath):
+            continue  # deleted since the merge base
+        if any(abspath == r or abspath.startswith(r + os.sep)
+               for r in roots):
+            files.append(abspath)
+    return files, None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="jaxlint", description=__doc__,
@@ -70,6 +116,10 @@ def main(argv=None) -> int:
                     help="skip the runtime partition-rule coverage check")
     ap.add_argument("--incremental", action="store_true",
                     help="serve unchanged files from the content-hash cache")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only .py files under the given paths that "
+                         "differ from `git merge-base HEAD main` (plus "
+                         "untracked files); exits 0 when nothing changed")
     ap.add_argument("--cache", default=DEFAULT_CACHE,
                     help="incremental cache file (gitignored)")
     ap.add_argument("--format", choices=("text", "json", "sarif"),
@@ -101,6 +151,20 @@ def main(argv=None) -> int:
         ap.print_usage(sys.stderr)
         print("jaxlint: error: no paths given", file=sys.stderr)
         return 2
+
+    if args.changed:
+        files, err = _changed_files(args.paths)
+        if files is None:
+            print(f"jaxlint: --changed unavailable ({err}) — "
+                  f"falling back to a full lint", file=sys.stderr)
+        elif not files:
+            print("jaxlint: --changed — no .py files differ from "
+                  "merge-base with main; nothing to lint")
+            return 0
+        else:
+            print(f"jaxlint: --changed — {len(files)} file(s) differ "
+                  f"from merge-base with main", file=sys.stderr)
+            args.paths = files
 
     t0 = time.perf_counter()
 

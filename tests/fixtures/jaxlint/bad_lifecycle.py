@@ -1,0 +1,73 @@
+"""Seeded block-lifecycle violations with EXPECT markers. Never
+imported, only parsed: the allocator/tables attributes are props for
+the AST pass, not live objects."""
+
+
+class LeakyEngine:
+    def admit_leak(self, slot, need):
+        chain = self.allocator.alloc(slot, need)
+        if need > self.width:
+            raise ValueError("too wide")  # EXPECT: lifecycle-alloc-leak
+        self.tables[slot] = chain
+
+    def admit_early_return(self, slot, need):
+        chain = self.allocator.alloc(slot, need)
+        if self.busy:
+            return False  # EXPECT: lifecycle-alloc-leak
+        self.tables[slot] = chain
+        return True
+
+    def admit_oom_guard_clean(self, slot, need):
+        chain = self.allocator.alloc(slot, need)  # CLEAN: lifecycle-alloc-leak
+        if chain is None:
+            return False  # the OOM idiom: nothing was allocated
+        self.tables[slot] = chain
+        return True
+
+    def admit_except_release_clean(self, slot, need):
+        chain = self.allocator.alloc_mixed(slot, [], need)
+        try:
+            self.transfer(chain)
+        except Exception:
+            self.allocator.free(slot)
+            raise  # CLEAN: lifecycle-alloc-leak (freed just above)
+        self.tables[slot] = chain
+        return True
+
+    def alloc_handoff_clean(self, slot, need):
+        chain = self.allocator.alloc(slot, need)
+        return chain  # CLEAN: lifecycle-alloc-leak (caller owns it)
+
+
+class RefTamper:
+    def poke_books(self, allocator, b):
+        allocator._refs[b] = 2  # EXPECT: lifecycle-refcount-outside-allocator
+        allocator._free.append(b)  # EXPECT: lifecycle-refcount-outside-allocator
+        allocator.incref(b)  # EXPECT: lifecycle-refcount-outside-allocator
+        allocator.decref(b)  # EXPECT: lifecycle-refcount-outside-allocator
+
+    def census_clean(self, allocator):
+        # reads are fine: only mutations bypass the allocator's checks
+        return len(allocator._refs)  # CLEAN: lifecycle-refcount-outside-allocator
+
+
+class SwapWindow:
+    def open_never_closed(self, slot):
+        self.allocator.set_state(slot, "swapping-out")  # EXPECT: lifecycle-span-imbalance
+        return self.gather(slot)
+
+    def open_escaping_raise(self, slot):
+        self.allocator.set_state(slot, "swapping-out")
+        blocks = self.gather(slot)
+        if blocks is None:
+            raise OSError("gather failed")  # EXPECT: lifecycle-span-imbalance
+        self.allocator.clear_state(slot)
+        return blocks
+
+    def open_close_balanced_clean(self, slot):
+        self.allocator.set_state(slot, "swapping-out")  # CLEAN: lifecycle-span-imbalance
+        try:
+            blocks = self.gather(slot)
+        finally:
+            self.allocator.clear_state(slot)
+        return blocks

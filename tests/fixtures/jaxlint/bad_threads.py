@@ -23,11 +23,20 @@ class Worker:
         self.results.append(1)  # EXPECT: thread-unsynced-mutation
         self.count += 1  # EXPECT: thread-unsynced-mutation
         self._locked_push()
+        self._acquire_push()
 
     def _locked_push(self):
         # reachable from the thread, but correctly guarded: no finding
         with self._lock:
             self.results.append(2)
+
+    def _acquire_push(self):
+        # bare acquire()/release() around try/finally is credited too
+        self._lock.acquire()
+        try:
+            self.results.append(3)  # CLEAN: thread-unsynced-mutation
+        finally:
+            self._lock.release()
 
     def summary(self):
         return len(self.results), self.count

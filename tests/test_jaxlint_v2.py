@@ -93,6 +93,9 @@ def test_v2_severities():
     assert by_rule["sharding-replicated"].severity == "warning"
     assert by_rule["thread-unsynced-mutation"].severity == "warning"
     assert by_rule["thread-blocking-signal"].severity == "error"
+    assert by_rule["lifecycle-alloc-leak"].severity == "error"
+    assert by_rule["lifecycle-refcount-outside-allocator"].severity == "error"
+    assert by_rule["lifecycle-span-imbalance"].severity == "warning"
 
 
 # ---- fingerprints ----------------------------------------------------------
@@ -145,7 +148,10 @@ def test_cli_list_rules_includes_v2_families():
     for rule in ("donation-use-after-donate", "donation-alias",
                  "donation-none-hot-loop", "sharding-unknown-axis",
                  "sharding-spec-arity", "sharding-replicated",
-                 "thread-unsynced-mutation", "thread-blocking-signal"):
+                 "thread-unsynced-mutation", "thread-blocking-signal",
+                 "lifecycle-alloc-leak",
+                 "lifecycle-refcount-outside-allocator",
+                 "lifecycle-span-imbalance"):
         assert rule in res.stdout, rule
 
 
