@@ -203,7 +203,7 @@ def collect_axis_constants(modules: Sequence[ParsedModule]) -> Dict[str, str]:
 Rule = Callable[[ParsedModule, LintContext], List[Finding]]
 
 #: bump when any rule's behaviour changes — invalidates incremental caches
-RULE_VERSION = "jaxlint-2.1"
+RULE_VERSION = "jaxlint-2.2"
 
 # partition-coverage is the one rule whose implementation needs a live
 # jax import, so its catalogue entry lives here (stdlib territory), not

@@ -34,19 +34,32 @@ lint time instead.
   tick) are real and deliberate — suppress inline with the reason, so
   the protocol is recorded next to the open it justifies.
 
+- ``lifecycle-fault-site-untested`` (error): a ``fault_point("serve.*")``
+  literal in scanned code whose site string never appears in the chaos
+  matrix (``tests/test_chaos_matrix.py``). A serve-side fault site that
+  no chaos scenario exercises is dead armor: the failure plane's
+  recovery guarantees (harvest, re-dispatch, deadline expiry) are only
+  as real as the grid that proves them, so every new site must land
+  with a matrix entry. Missing chaos file → every serve site flags.
+
 Boundaries (documented in ANALYSIS.md): the analysis is lexical within
 one function — acquire/release pairs split across functions need a
 suppression stating the protocol; "commit" means a store into a
 ``.tables``-named subscript, so an engine committing through a helper
 would need its commit recognized the same way; aliasing (``a = self
 .allocator; a._refs[...]``) is visible, but re-exporting the books
-through another name is not.
+through another name is not. The fault-site rule reads the chaos file
+as TEXT (a substring probe for the site literal), not as a parsed
+module — ``run_lint`` scans only the paths it is given, and tests are
+deliberately outside that set; the rule stays ``CROSS_MODULE=False``
+because the probe needs no other scanned module, only the repo layout.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Tuple
+import os
+from typing import Dict, List, Optional, Tuple
 
 from pytorch_distributed_tpu.analysis.core import (
     Finding,
@@ -107,6 +120,24 @@ RULES = [
         "in the finalize step next tick — are the one sanctioned "
         "imbalance: suppress inline with the reason, so the protocol "
         "is recorded at the open site.",
+    ),
+    RuleInfo(
+        "lifecycle-fault-site-untested", "error",
+        "serve-side fault_point site has no chaos-matrix entry in "
+        "tests/test_chaos_matrix.py",
+        "A fault_point(\"serve.*\") call whose site string appears "
+        "nowhere in tests/test_chaos_matrix.py. The serve fault sites "
+        "exist so the chaos matrix can kill a replica at every "
+        "dispatch/collect/handoff boundary and prove the failure "
+        "plane's guarantees — every request finishes, sheds, or "
+        "expires; blocks never leak; span trees close. A site without "
+        "a matrix entry is untested armor: the injection point ships, "
+        "but nothing ever proves recovery from a fault there. Add a "
+        "scenario (or extend the parametrized grid) that injects at "
+        "the new site; if the chaos file itself is missing, every "
+        "serve site flags until it exists. The probe is textual by "
+        "design — naming the site string in the test file is the "
+        "contract.",
     ),
 ]
 
@@ -354,6 +385,81 @@ def _check_span_imbalance(fn: ast.FunctionDef, mod: ParsedModule,
         ))
 
 
+# ---- lifecycle-fault-site-untested -----------------------------------------
+
+#: where the chaos matrix lives, relative to the repo root that owns
+#: the scanned module (derived per-module from abspath minus path)
+_CHAOS_TEST_RELPATH = "tests/test_chaos_matrix.py"
+
+#: chaos-file text cache keyed by (path, mtime_ns, size) — one read per
+#: repo per process, yet an edited (or newly created) chaos file is
+#: picked up on the next run instead of serving stale text
+_CHAOS_CACHE: Dict[Tuple[str, Optional[int], Optional[int]],
+                   Optional[str]] = {}
+
+
+def _chaos_text(mod: ParsedModule) -> Optional[str]:
+    """The chaos matrix's source text for the repo owning ``mod``, or
+    None when the file does not exist (or the root is underivable)."""
+    ab = mod.abspath.replace(os.sep, "/")
+    rel = mod.path
+    if not ab.endswith(rel):
+        return None
+    chaos = ab[: len(ab) - len(rel)] + _CHAOS_TEST_RELPATH
+    try:
+        st = os.stat(chaos)
+        key = (chaos, st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+    if key not in _CHAOS_CACHE:
+        try:
+            with open(chaos, "r", encoding="utf-8") as f:
+                _CHAOS_CACHE[key] = f.read()
+        except OSError:
+            _CHAOS_CACHE[key] = None
+    return _CHAOS_CACHE[key]
+
+
+def _check_fault_site_untested(mod: ParsedModule,
+                               findings: List[Finding]) -> None:
+    sites: List[Tuple[int, str]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = (
+            node.func.id if isinstance(node.func, ast.Name)
+            else node.func.attr if isinstance(node.func, ast.Attribute)
+            else None
+        )
+        if name != "fault_point" or not node.args:
+            continue
+        arg = node.args[0]
+        if (
+            isinstance(arg, ast.Constant)
+            and isinstance(arg.value, str)
+            and arg.value.startswith("serve.")
+        ):
+            sites.append((node.lineno, arg.value))
+    if not sites:
+        return
+    text = _chaos_text(mod)
+    for line, site in sites:
+        if text is not None and site in text:
+            continue
+        detail = (
+            f"the chaos matrix ({_CHAOS_TEST_RELPATH}) does not exist"
+            if text is None else
+            f"the site string never appears in {_CHAOS_TEST_RELPATH}"
+        )
+        findings.append(Finding(
+            "lifecycle-fault-site-untested", "error", mod.path, line,
+            f"serve fault site {site!r} has no chaos-matrix entry — "
+            f"{detail}; add a scenario that injects at this site so "
+            f"the failure plane's recovery from it is proven, not "
+            f"assumed",
+        ))
+
+
 def check_lifecycle(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(mod.tree):
@@ -361,6 +467,7 @@ def check_lifecycle(mod: ParsedModule, ctx: LintContext) -> List[Finding]:
             _check_alloc_leak(node, mod, findings)
             _check_span_imbalance(node, mod, findings)
     _check_refcount_outside(mod, findings)
+    _check_fault_site_untested(mod, findings)
     return findings
 
 

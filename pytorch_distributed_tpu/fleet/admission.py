@@ -165,12 +165,23 @@ class SLOGate:
     # ---- the routing decision ----
 
     def route(self, metrics: Dict[int, dict],
-              preferred: Optional[int] = None) -> Decision:
+              preferred: Optional[int] = None,
+              deadline_s: Optional[float] = None) -> Decision:
         """Pick a replica for one request given each candidate replica's
         live metrics (``{replica_id: metrics_dict}``) and the session's
-        affinity replica (None for session-less requests)."""
+        affinity replica (None for session-less requests).
+
+        ``deadline_s`` is the request's remaining deadline budget
+        (seconds; None = no deadline). A request that arrives already
+        expired — or will expire before any replica could plausibly
+        admit it — is shed HERE with reason ``"deadline-expired"``:
+        admission is the first enforcement point of the per-request
+        deadline (round 19), and an honest immediate expiry beats
+        queueing work the client has already abandoned."""
         if not metrics:
             raise ValueError("route() needs at least one candidate replica")
+        if deadline_s is not None and deadline_s <= 0:
+            return Decision(SHED, -1, "deadline-expired")
         hot = {i: self.hot(m) for i, m in metrics.items()}
         if preferred is not None and hot.get(preferred) is None:
             return Decision(ADMIT, preferred, "")
@@ -252,8 +263,10 @@ def trace_decision(reqtrace, rid: int, decision: Decision, *,
     reason the affinity replica was left (a queue-on-hot-fleet admit is
     an admit whose reason names the SLO signal — the "queue"
     backpressure rung). A shed CLOSES the root immediately: the trace
-    is complete, outcome ``shed``, and ``--assert-complete`` holds for
-    rejected requests too. Returns the root span id."""
+    is complete, outcome ``shed`` (``deadline`` when the shed rung was
+    the gate's deadline check — the request expired at admission, not
+    for capacity), and ``--assert-complete`` holds for rejected
+    requests too. Returns the root span id."""
     root = reqtrace.open_root(
         rid, session=session, prompt_len=prompt_len
     )
@@ -265,7 +278,11 @@ def trace_decision(reqtrace, rid: int, decision: Decision, *,
         preferred=preferred,
     )
     if decision.action == SHED:
-        reqtrace.end(root, outcome="shed", reason=decision.reason)
+        outcome = (
+            "deadline" if decision.reason == "deadline-expired"
+            else "shed"
+        )
+        reqtrace.end(root, outcome=outcome, reason=decision.reason)
     return root
 
 

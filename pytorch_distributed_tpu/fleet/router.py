@@ -54,10 +54,36 @@ replicas keep the registry story simple: ``registries()`` builds one
 ``compilecache.serving_registry`` per replica (per-mesh/per-device) and
 ``assert_registry_covers()`` runs the coverage guard across all of
 them.
+
+Failure plane (round 19; ANALYSIS.md "Failure model & recovery
+guarantees"): every replica carries a health state machine —
+``healthy → suspect → dead → draining → rejoining`` — driven by
+exceptions escaping ``dispatch_tick``/``collect_tick``/the handoff
+trio and by the serve-side watchdog's tick deadline
+(``resilience.watchdog.FleetWatchdog``; a tick that overruns
+``tick_deadline_s`` condemns its replica exactly like a crash — a
+wedged device loop and a dead process are indistinguishable from the
+control plane). A condemned replica is **drained of identity**: its
+in-flight requests are harvested from their ``Request`` records
+(``Scheduler.harvest_requests``), its device state torn down leak-free
+(``Scheduler.abandon``; blocksan-verified), its affinity entries
+invalidated, and the harvested requests re-dispatched to surviving
+replicas with bounded deterministic backoff
+(``resilience.retry.backoff_delays``) — each replay re-submits the
+original prompt plus every token the router already DELIVERED, so the
+prefix cache absorbs the replay cost and greedy client streams stay
+append-consistent (token-identical to a fault-free run). An attempt
+cap sheds the request with ``outcome="failed"`` instead of retrying
+forever; a request whose deadline lapses anywhere in this machinery
+expires with ``outcome="deadline"``. ``revive(i)`` re-admits a fresh
+replica at a dead slot behind compile-cache warmup — survivors never
+recompile (registry-fingerprint proof) and no request drops during
+the rejoin.
 """
 
 from __future__ import annotations
 
+import logging
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -69,13 +95,29 @@ from pytorch_distributed_tpu.fleet.admission import (
     PREEMPT,
     SHED,
     SPILL,
+    Decision,
     SLOConfig,
     SLOGate,
     recommend_replicas,
     trace_decision,
 )
+from pytorch_distributed_tpu.resilience.retry import backoff_delays
+from pytorch_distributed_tpu.resilience.watchdog import FleetWatchdog
 from pytorch_distributed_tpu.serving.scheduler import Scheduler
 from pytorch_distributed_tpu.telemetry import LatencySeries, percentiles
+
+logger = logging.getLogger("pytorch_distributed_tpu")
+
+#: the replica health state machine (round 19). ``draining`` is the
+#: instant between condemnation and the end of harvest+abandon —
+#: observable in the ``kind="health"`` JSONL even though the one-loop
+#: simulation passes through it synchronously; ``rejoining`` is a
+#: revived replica warming its compile cache before taking traffic.
+HEALTH_STATES = ("healthy", "suspect", "dead", "draining", "rejoining")
+
+#: health states the router routes traffic to (suspect replicas keep
+#: serving — one failed tick is a warning, not a death sentence)
+_ROUTABLE = ("healthy", "suspect")
 
 
 class FleetRouter:
@@ -98,6 +140,10 @@ class FleetRouter:
                  flightrec=None, reqtrace=None, ledger=None,
                  async_host: bool = False, host_threads: int = 2,
                  affinity_cap: int = 4096,
+                 fail_threshold: int = 2,
+                 tick_deadline_s: Optional[float] = None,
+                 redispatch_max_attempts: int = 3,
+                 redispatch_base_delay_s: float = 0.05,
                  **scheduler_kwargs):
         import jax
 
@@ -156,6 +202,18 @@ class FleetRouter:
         from pytorch_distributed_tpu.analysis.blocksan import maybe_sanitizer
 
         self.blocksan = maybe_sanitizer(metrics_log=metrics_log)
+        # construction inputs are retained so ``revive()`` can rebuild a
+        # dead replica slot from scratch with identical geometry — the
+        # handoff and the registry fingerprint both require it
+        self._config = config
+        self._params = params
+        self._devices = devices
+        self._seed = seed
+        self._tracer = tracer
+        self._disaggregate = disaggregate
+        self._n_prefill = n_prefill
+        self._decode_slots = decode_slots
+        self._scheduler_kwargs = scheduler_kwargs
         self.replicas: List[Scheduler] = []
         self.roles: List[str] = []
         for i in range(n_replicas):
@@ -163,29 +221,8 @@ class FleetRouter:
                 ("prefill" if i < n_prefill else "decode")
                 if disaggregate else "mixed"
             )
-            # one device per replica, round-robin over the host's slice
-            # of jax.devices(); on a single-device host all replicas
-            # share it (placement left implicit — bit-identical to a
-            # plain Scheduler)
-            dev = devices[i % len(devices)] if len(devices) > 1 else None
-            # disaggregation sizes roles independently (the DistServe
-            # argument): a request holds a prefill slot for
-            # ceil(prompt/chunk) ticks but a decode slot for max_new
-            # ticks, so decode replicas usually want MORE lanes — pool
-            # block geometry stays uniform (the handoff requires it),
-            # only the lane count differs
-            kw = dict(scheduler_kwargs)
-            if role == "decode" and decode_slots is not None:
-                kw["n_slots"] = decode_slots
-            self.replicas.append(Scheduler(
-                config, params, replica_id=i, seed=seed + i,
-                prefill_only=(role == "prefill"), device=dev,
-                handoff=disaggregate, metrics_log=metrics_log,
-                tracer=tracer, flightrec=self.flightrec,
-                reqtrace=self.reqtrace, ledger=self.ledger,
-                host_pool=self.host_pool, blocksan=self.blocksan, **kw,
-            ))
             self.roles.append(role)
+            self.replicas.append(self._make_replica(i))
         self.disaggregated = disaggregate
         #: max KV handoffs per tick (None = unbounded). The handoff's
         #: host-driven gather/put/scatter runs between decode ticks in
@@ -229,6 +266,379 @@ class FleetRouter:
         # drained fleet always says "hold" — so the router samples the
         # recommendation as it runs and keeps the high-water mark
         self._recommend_peak = len(self.entry_group)
+        # ---- failure plane (round 19) ----
+        if fail_threshold < 1:
+            raise ValueError(
+                f"fail_threshold must be >= 1, got {fail_threshold}"
+            )
+        if redispatch_max_attempts < 1:
+            raise ValueError(
+                "redispatch_max_attempts must be >= 1, "
+                f"got {redispatch_max_attempts}"
+            )
+        #: consecutive failed ticks before suspect escalates to dead —
+        #: one transient exception marks the replica suspect and is
+        #: forgiven by the next clean tick; ``fail_threshold`` in a row
+        #: condemns it
+        self.fail_threshold = fail_threshold
+        #: wall-clock budget for one replica tick; a tick that overruns
+        #: it condemns the replica immediately (a wedged device loop has
+        #: no exception to catch — the deadline IS its failure signal).
+        #: None disables hang detection.
+        self.tick_deadline_s = tick_deadline_s
+        self.redispatch_max_attempts = redispatch_max_attempts
+        self.redispatch_base_delay_s = redispatch_base_delay_s
+        #: per-replica health records (the state machine lives here, not
+        #: on the Scheduler: a dead replica's scheduler object is torn
+        #: down and replaced, but its health history must survive)
+        self.health: List[dict] = [
+            {"state": "healthy", "consecutive": 0, "failures": 0,
+             "last_error": None, "since_tick": 0,
+             "redispatched_away": 0, "deaths": 0}
+            for _ in range(n_replicas)
+        ]
+        #: rid -> immutable origin facts captured at FIRST death:
+        #: the true original prompt (tokens[:orig_len] before any
+        #: replay widened it), budget, session, absolute deadline, and
+        #: the attempt counter. Replays after later deaths rebuild from
+        #: here + the delivered-token record, never from the dying
+        #: scheduler's view.
+        self._origin: Dict[int, dict] = {}
+        #: harvested requests awaiting re-dispatch: each entry
+        #: {rid, not_before, src} — not_before is the deterministic
+        #: backoff instant (resilience.retry.backoff_delays, seeded by
+        #: rid so the chaos matrix replays bit-identically)
+        self._pending_redispatch: List[dict] = []
+        #: rid -> reason, requests shed AFTER admission: the re-dispatch
+        #: attempt cap was exhausted. Disjoint from ``rejected`` (never
+        #: admitted) — a failed rid may have streamed partial tokens.
+        self.failed: Dict[int, str] = {}
+        self._redispatched = 0
+        self._deadline_expired_redispatch = 0
+        self._deadline_sheds = 0
+        self._ticking: Optional[int] = None
+        # serve-side watchdog: one heartbeat per replica, beaten at the
+        # top of each tick. The one-loop simulation can only wedge
+        # inside the CURRENTLY ticking replica, so the stall handler
+        # ignores every other (merely aging) heartbeat; the thread is
+        # the live-stall observer, while step() itself re-checks the
+        # tick wall clock after the fact so hang condemnation is
+        # deterministic under test (no thread timing in the loop).
+        self.watchdog: Optional[FleetWatchdog] = None
+        if tick_deadline_s is not None:
+            self.watchdog = FleetWatchdog(
+                tick_deadline_s, on_stall=self._on_stall,
+                flightrec=self.flightrec,
+            )
+            for i in range(n_replicas):
+                self.watchdog.watch(f"replica{i}")
+
+    def _make_replica(self, i: int) -> Scheduler:
+        """Build replica ``i``'s Scheduler from the retained
+        construction inputs — used by ``__init__`` and by ``revive()``
+        (a revived slot gets a FRESH scheduler/engine/pool with the
+        same geometry, device placement, and seed as the dead one, so
+        the registry fingerprint and greedy streams are unchanged)."""
+        role = self.roles[i]
+        # one device per replica, round-robin over the host's slice
+        # of jax.devices(); on a single-device host all replicas
+        # share it (placement left implicit — bit-identical to a
+        # plain Scheduler)
+        dev = (
+            self._devices[i % len(self._devices)]
+            if len(self._devices) > 1 else None
+        )
+        # disaggregation sizes roles independently (the DistServe
+        # argument): a request holds a prefill slot for
+        # ceil(prompt/chunk) ticks but a decode slot for max_new
+        # ticks, so decode replicas usually want MORE lanes — pool
+        # block geometry stays uniform (the handoff requires it),
+        # only the lane count differs
+        kw = dict(self._scheduler_kwargs)
+        if role == "decode" and self._decode_slots is not None:
+            kw["n_slots"] = self._decode_slots
+        return Scheduler(
+            self._config, self._params, replica_id=i,
+            seed=self._seed + i, prefill_only=(role == "prefill"),
+            device=dev, handoff=self._disaggregate,
+            metrics_log=self.metrics_log, tracer=self._tracer,
+            flightrec=self.flightrec, reqtrace=self.reqtrace,
+            ledger=self.ledger, host_pool=self.host_pool,
+            blocksan=self.blocksan, **kw,
+        )
+
+    # ---- health plane ----
+
+    def _set_health(self, i: int, state: str, reason: str) -> None:
+        rec = self.health[i]
+        prev = rec["state"]
+        if state == prev:
+            return
+        rec["state"] = state
+        rec["since_tick"] = self._tick
+        logger.info(
+            "fleet health: replica %d %s -> %s (%s)", i, prev, state,
+            reason,
+        )
+        self.flightrec.record(
+            "health", replica=i, state=state, prev=prev, reason=reason
+        )
+        if self.metrics_log is not None:
+            self.metrics_log.log(
+                kind="health", replica_id=i, state=state, prev=prev,
+                reason=reason, tick=self._tick,
+            )
+
+    def _alive(self, group: List[int]) -> List[int]:
+        """Members of ``group`` the router still routes to. Suspect
+        replicas stay routable (their next clean tick clears them);
+        dead, draining, and rejoining ones do not."""
+        return [i for i in group if self.health[i]["state"] in _ROUTABLE]
+
+    def _on_stall(self, name: str, stalled_s: float, dump: str) -> None:
+        # live-stall observer (watchdog thread): only the CURRENTLY
+        # ticking replica can genuinely wedge in the one-loop
+        # simulation — every other heartbeat merely ages while it runs.
+        # The handler just records; condemnation happens in _run_tick's
+        # deterministic wall-clock re-check so tests never race the
+        # poller thread.
+        ticking = self._ticking
+        if ticking is None or name != f"replica{ticking}":
+            return
+        logger.error(
+            "fleet watchdog: replica %d tick stalled %.3fs "
+            "(deadline %.3fs)", ticking, stalled_s, self.tick_deadline_s,
+        )
+
+    def _note_success(self, i: int) -> None:
+        rec = self.health[i]
+        rec["consecutive"] = 0
+        if rec["state"] == "suspect":
+            self._set_health(i, "healthy", "tick-recovered")
+
+    def _note_failure(self, i: int, exc: BaseException,
+                      site: str = "tick") -> None:
+        """One failed tick (or handoff touch): suspect on the first,
+        condemned at ``fail_threshold`` consecutive."""
+        rec = self.health[i]
+        if rec["state"] in ("dead", "draining"):
+            return
+        rec["consecutive"] += 1
+        rec["failures"] += 1
+        rec["last_error"] = f"{type(exc).__name__}: {exc}"
+        logger.warning(
+            "fleet health: replica %d %s failure %d/%d: %s", i, site,
+            rec["consecutive"], self.fail_threshold, rec["last_error"],
+        )
+        if rec["consecutive"] >= self.fail_threshold:
+            self._condemn(i, f"{site}-failures:{rec['consecutive']}")
+        else:
+            self._set_health(i, "suspect", rec["last_error"])
+
+    def _condemn(self, i: int, reason: str) -> None:
+        """Declare replica ``i`` dead: harvest every in-flight request
+        from its ``Request`` records, tear its device state down
+        leak-free (``Scheduler.abandon``; the dead replica may lose
+        tokens, never blocks), invalidate its affinity entries, and
+        queue the survivors' replays with deterministic backoff."""
+        rec = self.health[i]
+        if rec["state"] in ("dead", "draining"):
+            return
+        self._set_health(i, "draining", reason)
+        s = self.replicas[i]
+        harvested = s.harvest_requests()
+        s.abandon()
+        now = time.perf_counter()
+        for req in harvested:
+            rid = req.rid
+            if rid not in self._origin:
+                # captured exactly ONCE, at FIRST death: here
+                # tokens[:orig_len] IS the true original prompt. After
+                # a re-dispatch the request's tokens already embed
+                # previously delivered output, so a second capture
+                # would double-count it in the next replay.
+                self._origin[rid] = {
+                    "prompt": np.asarray(
+                        req.tokens[:req.orig_len], dtype=np.int32
+                    ).copy(),
+                    "max_new": req.max_new_tokens,
+                    "session": req.session,
+                    "deadline": req.deadline,
+                    "attempts": 0,
+                }
+            origin = self._origin[rid]
+            self.placement.pop(rid, None)
+            if req.deadline <= now:
+                self._expire_request(rid, "replica-death")
+                continue
+            origin["attempts"] += 1
+            rec["redispatched_away"] += 1
+            if origin["attempts"] > self.redispatch_max_attempts:
+                self._fail_request(
+                    rid,
+                    f"redispatch-attempts-exhausted:"
+                    f"{self.redispatch_max_attempts}",
+                )
+                continue
+            # deterministic bounded backoff: the rid seeds the jitter so
+            # a chaos-matrix replay re-derives the same delays, and the
+            # attempt index walks the exponential schedule
+            delays = backoff_delays(
+                retries=self.redispatch_max_attempts,
+                base_delay=self.redispatch_base_delay_s, seed=rid,
+            )
+            delay = delays[min(origin["attempts"] - 1, len(delays) - 1)]
+            self._pending_redispatch.append(
+                {"rid": rid, "not_before": now + delay, "src": i}
+            )
+            if self.reqtrace.enabled:
+                self.reqtrace.event(
+                    rid, "redispatch_queued", src=i,
+                    attempt=origin["attempts"],
+                    delay_s=round(delay, 6),
+                )
+        # affinity entries pinned to the dead replica are invalid — a
+        # returning session re-pins wherever the gate sends it (its
+        # prefix blocks died with the pool anyway)
+        for sess in [s_ for s_, r in self._affinity.items() if r == i]:
+            del self._affinity[sess]
+        if self.watchdog is not None:
+            self.watchdog.unwatch(f"replica{i}")
+        rec["deaths"] += 1
+        rec["consecutive"] = 0
+        self._set_health(i, "dead", reason)
+
+    def _fail_request(self, rid: int, reason: str) -> None:
+        """Attempt cap exhausted: shed ``rid`` with outcome=failed —
+        the post-admission twin of the gate's shed (the client may have
+        seen partial tokens; the stream simply never completes)."""
+        self.failed[rid] = reason
+        self._origin.pop(rid, None)
+        self.flightrec.record("request_failed", rid=rid, reason=reason)
+        if self.reqtrace.enabled:
+            root = self.reqtrace.open_root(rid)
+            self.reqtrace.end(root, outcome="failed", reason=reason)
+        if self.metrics_log is not None:
+            self.metrics_log.log(
+                kind="request", rid=rid, replica_id=-1, rejected=True,
+                reject_reason=reason, outcome="failed",
+                new_tokens=len(self.results.get(rid, ())),
+            )
+
+    def _expire_request(self, rid: int, where: str) -> None:
+        """Deadline lapsed while the request sat in the router's own
+        machinery (harvested, or waiting out backoff) — the router is
+        an enforcement point just like the scheduler tick."""
+        self._deadline_expired_redispatch += 1
+        self._origin.pop(rid, None)
+        self.flightrec.record("deadline", rid=rid, where=where)
+        if self.reqtrace.enabled:
+            root = self.reqtrace.open_root(rid)
+            self.reqtrace.end(
+                root, outcome="deadline", reason=f"expired-{where}"
+            )
+        if self.metrics_log is not None:
+            self.metrics_log.log(
+                kind="request", rid=rid, replica_id=-1, rejected=True,
+                reject_reason=f"deadline-expired-{where}",
+                outcome="deadline",
+                new_tokens=len(self.results.get(rid, ())),
+            )
+
+    def _pump_redispatch(self) -> None:
+        """Re-submit harvested requests to surviving entry replicas.
+        The replay prompt is the ORIGINAL prompt plus every token the
+        router already DELIVERED for the rid (``self.results`` is the
+        authoritative client-visible stream — produced-but-uncollected
+        tokens died with the replica and are regenerated), so the
+        surviving stream stays append-consistent and the prefix cache
+        absorbs most of the replay's prefill. Re-admission bypasses the
+        SLO gate: the request was already admitted once — replica loss
+        must not demote it to a sheddable newcomer."""
+        if not self._pending_redispatch:
+            return
+        now = time.perf_counter()
+        alive = self._alive(self.entry_group)
+        still_waiting: List[dict] = []
+        for entry in self._pending_redispatch:
+            rid = entry["rid"]
+            origin = self._origin.get(rid)
+            if origin is None:  # failed/expired since it was queued
+                continue
+            if origin["deadline"] <= now:
+                self._expire_request(rid, "redispatch-wait")
+                continue
+            if not alive or now < entry["not_before"]:
+                # backoff not elapsed, or no survivor to take it —
+                # hold (a later revive() drains this queue)
+                still_waiting.append(entry)
+                continue
+            delivered = self.results.get(rid, [])
+            remaining = origin["max_new"] - len(delivered)
+            if remaining <= 0:
+                # every budgeted token was already delivered before the
+                # replica died mid-retire — the stream is complete
+                if self.reqtrace.enabled:
+                    root = self.reqtrace.open_root(rid)
+                    self.reqtrace.end(root, outcome="complete",
+                                      reason="redispatch-noop")
+                self._origin.pop(rid, None)
+                continue
+            prompt = origin["prompt"]
+            if delivered:
+                prompt = np.concatenate(
+                    [prompt, np.asarray(delivered, dtype=np.int32)]
+                )
+            target = min(
+                alive,
+                key=lambda j: (len(self.replicas[j].resident)
+                               + len(self.replicas[j].queue)),
+            )
+            self.replicas[target].submit(
+                prompt, int(remaining), session=origin["session"],
+                rid=rid, deadline=origin["deadline"],
+            )
+            self.placement[rid] = target
+            self._redispatched += 1
+            if origin["session"] is not None:
+                # re-pin the session where its replayed prefix now lives
+                self._affinity[origin["session"]] = target
+                self._affinity.move_to_end(origin["session"])
+            self.flightrec.record(
+                "redispatch", rid=rid, src=entry["src"], dst=target,
+                attempt=origin["attempts"],
+                replayed=len(delivered),
+            )
+            if self.reqtrace.enabled:
+                self.reqtrace.event(
+                    rid, "redispatch", src=entry["src"], dst=target,
+                    attempt=origin["attempts"],
+                    replayed=len(delivered),
+                )
+        self._pending_redispatch = still_waiting
+
+    def revive(self, i: int, *, warmup: bool = True,
+               background: bool = False) -> None:
+        """Re-admit a fresh replica at dead slot ``i``: a new
+        scheduler/engine/pool with the old slot's exact geometry,
+        device, and seed, warmed through the compile cache BEFORE the
+        rejoining→healthy flip so its first real tick pays no compile
+        (and survivors, untouched, never recompile — the registry
+        fingerprint proof in the chaos tests)."""
+        rec = self.health[i]
+        if rec["state"] != "dead":
+            raise RuntimeError(
+                f"revive: replica {i} is {rec['state']}, not dead"
+            )
+        self._set_health(i, "rejoining", "revive")
+        self.replicas[i] = self._make_replica(i)
+        if warmup:
+            self.replicas[i].warmup(background=background)
+        rec["consecutive"] = 0
+        rec["last_error"] = None
+        if self.watchdog is not None:
+            self.watchdog.watch(f"replica{i}")
+        self._set_health(i, "healthy", "revived")
 
     # ---- routing ----
 
@@ -240,21 +650,38 @@ class FleetRouter:
         return {i: self.replicas[i].gate_metrics() for i in group}
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int, *,
-               session: Optional[int] = None) -> int:
+               session: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Route one request; returns its fleet rid. A shed request gets
         a rid too — ``rejected[rid]`` holds the reason and no tokens
-        will ever stream for it (the explicit fast-reject contract)."""
+        will ever stream for it (the explicit fast-reject contract).
+        ``deadline_s`` is a relative latency budget: the gate sheds an
+        already-expired one at admission, and the absolute instant it
+        fixes travels on the ``Request`` through every replica hop —
+        re-dispatch does NOT grant a fresh budget."""
         rid = self._next_rid
         self._next_rid += 1
+        # dead/draining/rejoining replicas take no traffic: the gate
+        # only ever sees alive entry replicas, and a fully-dead entry
+        # group sheds explicitly instead of routing into a corpse
+        alive = self._alive(self.entry_group)
         preferred = None
         if session is not None:
             preferred = self._affinity.get(session)
             if preferred is not None:
                 self._affinity.move_to_end(session)  # LRU touch
-        with self.ledger.host("admission/gate"):
-            decision = self.gate.route(
-                self._group_metrics(self.entry_group), preferred
-            )
+            if preferred is not None and preferred not in alive:
+                preferred = None  # pinned replica died; re-pin below
+        if not alive:
+            decision = Decision(SHED, -1, "fleet-unavailable")
+        else:
+            with self.ledger.host("admission/gate"):
+                decision = self.gate.route(
+                    self._group_metrics(alive), preferred,
+                    deadline_s=deadline_s,
+                )
+        if decision.action == SHED and decision.reason == "deadline-expired":
+            self._deadline_sheds += 1
         if self.reqtrace.enabled:
             # the gate decision opens the request's root span — the
             # first causal fact of its lifecycle (a shed closes it
@@ -304,6 +731,7 @@ class FleetRouter:
         self.replicas[target].submit(
             prompt, max_new_tokens, session=session,
             spilled=(decision.action == SPILL), rid=rid,
+            deadline_s=deadline_s,
         )
         self.placement[rid] = target
         return rid
@@ -320,22 +748,42 @@ class FleetRouter:
             if self.handoffs_per_tick is not None else float("inf")
         )
         order = sorted(
-            self.decode_group,
+            self._alive(self.decode_group),
             key=lambda i: (len(self.replicas[i].resident),
                            len(self.replicas[i].queue)),
         )
         preempted_this_pump = False
-        for pi in self.entry_group:
+        for pi in self._alive(self.entry_group):
             ps = self.replicas[pi]
             for rid in ps.ready_rids():
                 if budget <= 0:
                     return
-                req, export = ps.peek_ready(rid)
+                try:
+                    # serve.handoff_export fires inside export_chain; a
+                    # crash here kills the SOURCE replica — its parked
+                    # ready set (this rid included) harvests into the
+                    # re-dispatch queue, nothing adopted yet
+                    req, export = ps.peek_ready(rid)
+                except Exception as e:  # noqa: BLE001 — fault boundary
+                    self._note_failure(pi, e, site="handoff_export")
+                    break
                 t0 = time.perf_counter()
-                adopted_by = next(
-                    (di for di in order
-                     if self.replicas[di].adopt(req, export)), None,
-                )
+                adopted_by = None
+                for di in order:
+                    if self.health[di]["state"] not in _ROUTABLE:
+                        continue  # condemned earlier in this same pump
+                    try:
+                        # serve.handoff_import fires inside import_chain
+                        # before any fresh block lands; a crash kills
+                        # the TARGET replica while the source's export
+                        # stays valid (the PR 16 failure-safe contract)
+                        # — the next candidate simply retries the adopt
+                        if self.replicas[di].adopt(req, export):
+                            adopted_by = di
+                            break
+                    except Exception as e:  # noqa: BLE001
+                        self._note_failure(di, e, site="handoff_import")
+                        continue
                 if adopted_by is None:
                     # no decode capacity this tick. Under the pressure
                     # tier, park ONE idle decode chain (LRU) so next
@@ -385,6 +833,46 @@ class FleetRouter:
                 )
                 budget -= 1
 
+    def _run_tick(self, i: int) -> List[Tuple[int, int]]:
+        """Tick replica ``i`` under the failure plane: heartbeat the
+        watchdog, catch any exception escaping the tick (→ suspect /
+        condemned), and re-check the tick's wall clock against
+        ``tick_deadline_s`` — a tick that overran the deadline condemns
+        its replica for ``hang`` even though it eventually returned
+        (the injected-hang simulation of a wedged device loop). Tokens
+        a hung tick DID flush are still delivered: they left the
+        replica before it was declared dead, and dropping them would
+        strand requests that retired during the hung tick."""
+        s = self.replicas[i]
+        toks: List[Tuple[int, int]] = []
+        self._ticking = i
+        if self.watchdog is not None:
+            self.watchdog.beat(f"replica{i}")
+        t0 = time.perf_counter()
+        try:
+            if self.async_host:
+                toks.extend(s.collect_tick())
+                s.dispatch_tick()
+            else:
+                toks.extend(s.step())
+        except Exception as e:  # noqa: BLE001 — the fault boundary
+            self._note_failure(i, e, site="tick")
+        else:
+            wall = time.perf_counter() - t0
+            if (self.tick_deadline_s is not None
+                    and wall >= self.tick_deadline_s):
+                # deterministic hang condemnation: measured on the loop
+                # itself, not the poller thread, so the chaos matrix
+                # never races the watchdog's poll cadence
+                self._condemn(i, f"tick-hang:{wall:.3f}s")
+            else:
+                self._note_success(i)
+                if self.watchdog is not None:
+                    self.watchdog.beat(f"replica{i}")
+        finally:
+            self._ticking = None
+        return toks
+
     def step(self) -> List[Tuple[int, int]]:
         """One fleet tick. Synchronous loop: tick each replica fully —
         decode replicas first (their token sync stays clear of this
@@ -402,22 +890,17 @@ class FleetRouter:
         if self._start_time is None:
             self._start_time = time.perf_counter()
         out: List[Tuple[int, int]] = []
-        order = self.decode_group + self.entry_group
-        if self.async_host:
-            # interleaved collect/dispatch: while replica i's freshly
-            # dispatched tick N is in flight, the loop is already
-            # collecting replica i+1's tick N−1 and building its tick N
-            # — every replica's dispatch-side host work (admissions,
-            # chunk batch build, table masking) overlaps some OTHER
-            # replica's device work, which a collect-all-then-
-            # dispatch-all phasing would leave serialized against an
-            # idle device
-            for i in order:
-                out.extend(self.replicas[i].collect_tick())
-                self.replicas[i].dispatch_tick()
-        else:
-            for i in order:
-                out.extend(self.replicas[i].step())
+        # harvested requests replay FIRST, so a request re-dispatched at
+        # tick N starts prefilling at tick N (once its backoff elapses)
+        # — no extra tick of dead air between death and recovery
+        self._pump_redispatch()
+        # note: interleaved collect/dispatch in the async loop — while
+        # replica i's freshly dispatched tick N is in flight, the loop
+        # is already collecting replica i+1's tick N−1 and building its
+        # tick N, so every replica's dispatch-side host work overlaps
+        # some OTHER replica's device work
+        for i in self._alive(self.decode_group + self.entry_group):
+            out.extend(self._run_tick(i))
         if self.decode_group:
             with self.ledger.host("handoff-pump"):
                 self._pump_handoffs()
@@ -434,9 +917,12 @@ class FleetRouter:
         # Scheduler.idle counts parked and mid-swap requests as
         # in-flight work, so a drain never strands a preempted stream;
         # has_uncollected keeps the async loop stepping until every
-        # in-flight tick's tokens have been collected AND delivered
-        return all(
-            s.idle and not s.has_uncollected for s in self.replicas
+        # in-flight tick's tokens have been collected AND delivered;
+        # pending re-dispatches are in-flight work too — a fleet with a
+        # harvested request waiting out its backoff is NOT idle
+        return (
+            all(s.idle and not s.has_uncollected for s in self.replicas)
+            and not self._pending_redispatch
         )
 
     def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
@@ -461,8 +947,18 @@ class FleetRouter:
                             s._san.verify_quiesce()
                 return dict(self.results)
             self.step()
+        # drain diagnostics (satellite, round 19): name the stuck rids
+        # by replica and state instead of a bare step count — the first
+        # question a wedged-fleet post-mortem asks
+        stuck = {
+            f"r{i}": s.stuck_rids()
+            for i, s in enumerate(self.replicas) if not s.idle
+        }
+        pending = sorted(e["rid"] for e in self._pending_redispatch)
         raise RuntimeError(
-            f"fleet drain did not converge within {max_steps} steps"
+            f"fleet drain did not converge within {max_steps} steps; "
+            f"stuck rids by replica/state: {stuck}; "
+            f"awaiting redispatch: {pending}"
         )
 
     def cancel(self, rid: int, reason: str = "client-cancel") -> bool:
@@ -576,6 +1072,23 @@ class FleetRouter:
             "affinity_sessions": len(self._affinity),
             "affinity_evictions": self._affinity_evictions,
             "cancelled": sum(m["cancelled"] for m in per),
+            # failure-plane rollup (round 19): health census, replica
+            # deaths, re-dispatch traffic, and the deadline ledger —
+            # "deadline_misses" are scheduler-tick expiries (the request
+            # was running), "deadline_sheds" died at the gate, and
+            # "deadline_expired_redispatch" lapsed inside the router's
+            # own recovery machinery
+            "replicas_healthy": sum(
+                1 for h in self.health if h["state"] in _ROUTABLE
+            ),
+            "replica_deaths": sum(h["deaths"] for h in self.health),
+            "redispatched": self._redispatched,
+            "redispatch_pending": len(self._pending_redispatch),
+            "failed": len(self.failed),
+            "deadline_misses": sum(m["deadline_misses"] for m in per),
+            "deadline_sheds": self._deadline_sheds,
+            "deadline_expired_redispatch":
+                self._deadline_expired_redispatch,
             **(self.blocksan.summary()
                if self.blocksan is not None else {}),
             "recommended_replicas": self.recommend_replicas(),
@@ -617,6 +1130,7 @@ class FleetRouter:
                 if k in m:
                     out[f"r{i}_{k}"] = m[k]
             out[f"r{i}_role"] = self.roles[i]
+            out[f"r{i}_health"] = self.health[i]["state"]
         return out
 
     def log_summary(self) -> None:

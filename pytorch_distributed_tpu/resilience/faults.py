@@ -33,7 +33,34 @@ site                  placed at
 ``kv.swap_in_h2d``    ``serving/engine.py`` ``swap_in_chain``, before
                       any device write of a restoring chain — a failure
                       frees the fresh blocks, host copy stays retryable
+``serve.dispatch``    ``serving/scheduler.py`` ``dispatch_tick``, before
+                      any admission or launch work of the tick — the
+                      replica fails with its resident set untouched;
+                      the router's health plane must harvest and
+                      re-dispatch every stranded request
+``serve.collect``     ``serving/scheduler.py`` ``collect_tick``, before
+                      the pending tick's device results are drained —
+                      tokens the device already produced are lost with
+                      the replica; replay must regenerate them
+``serve.handoff_export``
+                      ``serving/engine.py`` ``export_chain``, before the
+                      prefill replica's chain is read out — the decode
+                      side sees the failure mid-adopt, the export pin
+                      stays on the source until the router disposes of it
+``serve.handoff_import``
+                      ``serving/engine.py`` ``import_chain``, before any
+                      fresh block is allocated on the decode replica — a
+                      failure leaves the source chain intact and
+                      re-exportable (the PR 16 failure-safe contract)
 ====================  =====================================================
+
+The ``serve.*`` sites model *replica death*, not transient I/O: an
+exception escaping a serve tick marks the replica suspect/dead in the
+fleet health plane (``fleet/router.py``) rather than being retried in
+place, and recovery is re-dispatch of the stranded requests to
+surviving replicas. The ``hang`` kind at a serve site stands in for a
+wedged device loop: the tick returns late and the router's tick
+deadline, not an exception, is what condemns the replica.
 
 Fault kinds:
 

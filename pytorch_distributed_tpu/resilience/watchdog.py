@@ -208,3 +208,153 @@ class Watchdog:
                 self.on_stall(dump)
             except Exception:
                 logger.exception("watchdog: on_stall callback failed")
+
+
+class FleetWatchdog:
+    """Many named heartbeats, one watcher thread — the serve-side
+    generalization of :class:`Watchdog` for the fleet health plane.
+
+    The trainer watchdog guards ONE loop; a serving fleet has one
+    heartbeat per replica (``replica0`` … ``replicaN``) plus the router
+    loop itself, and a single wedged replica must be *named*, not just
+    noticed. ``watch(name)`` registers a heartbeat, ``beat(name)``
+    re-arms it, and a heartbeat that goes quiet past ``timeout_s``
+    fires ``on_stall(name, stalled_s, dump)`` ONCE (re-armed by the
+    next beat of that name) with the all-thread stack dump — the
+    router's callback marks the replica suspect/dead and the
+    re-dispatch machinery takes it from there. ``unwatch(name)``
+    retires a heartbeat (a dead replica must stop screaming).
+
+    Deterministic tests drive :meth:`check` directly instead of
+    starting the thread: it evaluates every armed heartbeat against
+    the deadline NOW and returns the names that fired — same logic,
+    no wall-clock race. The production path calls ``start()`` and the
+    daemon thread polls exactly like the trainer watchdog."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        *,
+        on_stall: Optional[Callable[[str, float, str], None]] = None,
+        dump_path: Optional[str] = None,
+        poll_s: Optional[float] = None,
+        flightrec=None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.on_stall = on_stall
+        self.dump_path = dump_path
+        self.flightrec = flightrec
+        self.poll_s = float(poll_s) if poll_s else min(
+            1.0, self.timeout_s / 4.0
+        )
+        self.stalls = 0
+        self._lock = threading.Lock()
+        # name -> (last beat monotonic, fired)
+        self._beats: dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetWatchdog":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="pdt-fleet-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "FleetWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- heartbeats --------------------------------------------------------
+
+    def watch(self, name: str) -> None:
+        """Register (or re-register) heartbeat ``name``, armed now."""
+        with self._lock:
+            self._beats[name] = (time.monotonic(), False)
+
+    def unwatch(self, name: str) -> None:
+        """Retire heartbeat ``name`` (replica dead or drained)."""
+        with self._lock:
+            self._beats.pop(name, None)
+
+    def beat(self, name: str) -> None:
+        """Heartbeat ``name`` made progress; re-arm its deadline."""
+        with self._lock:
+            self._beats[name] = (time.monotonic(), False)
+
+    def stalled(self) -> list:
+        """Names currently past deadline (fired or not) — a health
+        surface, no side effects."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                n for n, (last, _f) in self._beats.items()
+                if now - last >= self.timeout_s
+            )
+
+    # -- evaluation --------------------------------------------------------
+
+    def check(self) -> list:
+        """Evaluate every heartbeat against the deadline now; fire
+        ``on_stall`` for each newly-stalled name and return those
+        names. The watcher thread calls this each poll; deterministic
+        tests call it directly."""
+        now = time.monotonic()
+        fired = []
+        with self._lock:
+            for name, (last, already) in list(self._beats.items()):
+                if now - last >= self.timeout_s and not already:
+                    self._beats[name] = (last, True)
+                    fired.append((name, now - last))
+        for name, stalled_s in fired:
+            self.stalls += 1
+            self._handle_stall(name, stalled_s)
+        return [name for name, _ in fired]
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.check()
+
+    def _handle_stall(self, name: str, stalled_s: float) -> None:
+        dump = dump_all_stacks()
+        logger.error(
+            "fleet watchdog: no %s heartbeat for %.1fs (deadline "
+            "%.1fs); all-thread stacks:\n%s",
+            name, stalled_s, self.timeout_s, dump,
+        )
+        if self.dump_path:
+            try:
+                with open(self.dump_path, "a") as f:
+                    f.write(
+                        f"=== fleet watchdog stall #{self.stalls} "
+                        f"[{name}] ({stalled_s:.1f}s) ===\n{dump}\n"
+                    )
+            except OSError as e:
+                logger.error("fleet watchdog: could not write dump: %s",
+                             e)
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "watchdog_stall", n=self.stalls, heartbeat=name,
+                stalled_s=round(stalled_s, 3),
+            )
+        if self.on_stall is not None:
+            try:
+                self.on_stall(name, stalled_s, dump)
+            except Exception:
+                logger.exception(
+                    "fleet watchdog: on_stall callback failed"
+                )

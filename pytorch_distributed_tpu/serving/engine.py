@@ -949,7 +949,13 @@ class PagedEngine:
         stays resident until ``release``; the caller sequences export →
         ``import_chain`` on the target → release, so a failed import
         (target pool OOM) leaves the source intact and retryable."""
+        from pytorch_distributed_tpu.resilience.faults import fault_point
+
         self._require_handoff()
+        # replica-death site: before the chain is read out — the decode
+        # side sees the failure mid-adopt, the export pin stays on this
+        # source until the router's failure plane disposes of it
+        fault_point("serve.handoff_export")
         chain = self.allocator.chain(slot)
         if not chain:
             raise ValueError(f"slot {slot} holds no block chain to export")
@@ -978,7 +984,13 @@ class PagedEngine:
         remap the block table. Returns False (state unchanged) when the
         pool cannot supply the chain — the caller keeps the export and
         retries, exactly the deterministic-OOM contract of ``admit``."""
+        from pytorch_distributed_tpu.resilience.faults import fault_point
+
         self._require_handoff()
+        # replica-death site: before any fresh block is allocated here —
+        # a failure leaves the source chain intact and re-exportable
+        # (the PR 16 failure-safe handoff contract)
+        fault_point("serve.handoff_import")
         if export.block_len != self.block_len:
             raise ValueError(
                 f"cannot import block_len={export.block_len} blocks into "

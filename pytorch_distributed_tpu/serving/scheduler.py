@@ -123,6 +123,7 @@ import jax
 import numpy as np
 
 from pytorch_distributed_tpu.compilecache.aot import attribute_compile
+from pytorch_distributed_tpu.resilience.faults import fault_point
 from pytorch_distributed_tpu.telemetry import (
     NULL_LEDGER,
     NULL_RECORDER,
@@ -172,6 +173,16 @@ class Request:
     # affinity replica by the SLO gate — both land in the JSONL record
     session: Optional[int] = None
     spilled: bool = False
+    # ---- per-request deadline (round 19; ROADMAP item 5 rung) ----
+    # absolute ``time.perf_counter()`` instant after which the request
+    # expires through the cancel path with ``outcome="deadline"``. The
+    # deadline is absolute (not remaining seconds) so it survives
+    # re-dispatch to another replica unchanged — a request does not get
+    # a fresh budget by losing its replica. ``inf`` == no deadline.
+    deadline: float = float("inf")
+    # replica hops: every replica that has owned this request, in order
+    # (the re-dispatch chain ``scripts/explain_request.py`` renders)
+    redispatches: int = 0
     # ---- pressure tier (round 13; offload schedulers only) ----
     # the submitted prompt's length — ``tokens`` grows on a recompute
     # restore (generated tokens re-prefill as prompt), so the JSONL's
@@ -402,6 +413,7 @@ class Scheduler:
             if blocksan is not None else None
         )
         self._cancelled = 0
+        self._deadline_misses = 0
         # host–device overlap ledger (round 15; telemetry/overlap.py):
         # the engine reports every compiled launch through it, and the
         # host marks below (admission, JSONL emit, swap decision) are
@@ -515,7 +527,9 @@ class Scheduler:
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int, *,
                session: Optional[int] = None, spilled: bool = False,
-               rid: Optional[int] = None) -> int:
+               rid: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               deadline: Optional[float] = None) -> int:
         """Enqueue one request; returns its request id. Never raises for
         capacity — only for requests no configuration could serve, and
         for submission into a draining replica (the router must not
@@ -524,7 +538,14 @@ class Scheduler:
         ``session``/``spilled`` are fleet routing provenance stamped into
         the per-request JSONL; ``rid`` lets the fleet router allocate
         request ids from ONE fleet-wide space so a request keeps its id
-        across replicas and the prefill→decode handoff."""
+        across replicas and the prefill→decode handoff.
+
+        ``deadline_s`` (seconds from now) or ``deadline`` (an absolute
+        ``time.perf_counter()`` instant — what the router passes on
+        re-dispatch so the clock never resets) arms per-request
+        expiry: the deadline sweep at the top of every ``dispatch_tick``
+        expires the request through the cancel path with
+        ``outcome="deadline"`` whatever state it is in."""
         if self.draining:
             raise RuntimeError(
                 f"replica {self.replica_id} is draining; route elsewhere"
@@ -550,11 +571,16 @@ class Scheduler:
             self._next_rid += 1
         else:
             self._next_rid = max(self._next_rid, rid + 1)
+        now = time.perf_counter()
+        if deadline is None:
+            deadline = (now + deadline_s if deadline_s is not None
+                        else float("inf"))
         req = Request(
             rid=rid, tokens=prompt, max_new_tokens=max_new_tokens,
-            submit_step=self._step_count, submit_time=time.perf_counter(),
+            submit_step=self._step_count, submit_time=now,
             session=session, spilled=spilled, orig_len=l,
             generated=[] if self.offload else None,
+            deadline=deadline,
         )
         if self.reqtrace.enabled:
             # standalone schedulers open the root here; under a fleet the
@@ -1075,9 +1101,14 @@ class Scheduler:
                 "collect_tick() must drain the pending tick before "
                 "another dispatch (one tick in flight per replica)"
             )
+        # replica-death site: before ANY tick work, so a fault here
+        # leaves the resident set exactly as the last collect left it —
+        # the state the router's harvest/re-dispatch path must recover
+        fault_point("serve.dispatch")
         if self._start_time is None:
             self._start_time = time.perf_counter()
         t_step0 = time.perf_counter()
+        self._expire_deadlines()
         if self.offload:
             # pressure tier: close last tick's swap-out windows (their
             # blocks return to the pool), then restore parked requests
@@ -1220,6 +1251,10 @@ class Scheduler:
         JSONL). Returns ``[(rid, token)]`` — including anything an
         early collect (preempt/drain) stashed since the last call.
         No-op without a pending tick."""
+        # replica-death site: the tick's device tokens are lost with the
+        # replica (the router-facing collect only — the early collects
+        # inside preempt/cancel/drain are the same process surviving)
+        fault_point("serve.collect")
         self._collect_pending_tick()
         out, self._collected = self._collected, []
         return out
@@ -1481,6 +1516,32 @@ class Scheduler:
         return (not self.queue and not self.resident
                 and not self.parked and not self._swapping)
 
+    def stuck_rids(self) -> Dict[str, List[int]]:
+        """Every in-flight rid by lifecycle state — the drain loops'
+        non-convergence diagnostic (an empty dict == idle). A stuck
+        drain that only reported counts forced a debugger session; the
+        chaos matrix asserts on THIS surface instead."""
+        out: Dict[str, List[int]] = {}
+        if self.queue:
+            out["queued"] = [r.rid for r in self.queue]
+        prefill, decoding = [], []
+        for req in self.resident.values():
+            if req.rid in self.ready:
+                continue
+            (prefill if req.prefill_done < req.length
+             else decoding).append(req.rid)
+        if prefill:
+            out["prefill"] = sorted(prefill)
+        if decoding:
+            out["decoding"] = sorted(decoding)
+        if self.parked:
+            out["parked"] = sorted(self.parked)
+        if self._swapping:
+            out["swapping"] = sorted(e[0] for e in self._swapping)
+        if self.ready:
+            out["handoff-ready"] = sorted(self.ready)
+        return out
+
     def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Step until queue and lanes are empty; returns
         ``{rid: [tokens]}``."""
@@ -1491,8 +1552,8 @@ class Scheduler:
             for rid, tok in self.step():
                 produced.setdefault(rid, []).append(tok)
         raise RuntimeError(
-            f"drain did not converge within {max_steps} steps "
-            f"(queue={len(self.queue)}, resident={len(self.resident)})"
+            f"drain did not converge within {max_steps} steps; "
+            f"stuck rids by state: {self.stuck_rids()}"
         )
 
     # ---- graceful drain (fleet scale-down / replica removal) ----
@@ -1554,20 +1615,22 @@ class Scheduler:
             for rid, tok in self.step():
                 produced.setdefault(rid, []).append(tok)
         raise RuntimeError(
-            f"drain_graceful did not converge within {max_steps} steps "
-            f"(resident={len(self.resident)})"
+            f"drain_graceful did not converge within {max_steps} "
+            f"steps; stuck rids by state: {self.stuck_rids()}"
         )
 
     # ---- client cancellation (ROADMAP item 5's first rung) ----
 
-    def cancel(self, rid: int, reason: str = "client-cancel") -> bool:
+    def cancel(self, rid: int, reason: str = "client-cancel",
+               outcome: str = "cancelled") -> bool:
         """Abort request ``rid`` wherever it lives — queued, resident
         (mid-prefill or decoding), parked (either restore path), mid
         swap-out, or handoff-ready — freeing every resource it holds:
         device chain, host-store chain, slot, handoff pin. Closes the
-        request's span tree with ``outcome="cancelled"``. Returns True
-        when the rid was found (False: already retired or unknown — a
-        benign race, cancellation is idempotent).
+        request's span tree with ``outcome`` (``"cancelled"`` for a
+        client cancel; the deadline sweep passes ``"deadline"``).
+        Returns True when the rid was found (False: already retired or
+        unknown — a benign race, cancellation is idempotent).
 
         The blocksan cancellation-storm trace rides this path: after a
         storm over every lifecycle state, the ledger must equal the
@@ -1578,7 +1641,8 @@ class Scheduler:
         for i, req in enumerate(self.queue):
             if req.rid == rid:
                 del self.queue[i]
-                self._finish_cancel(req, slot=None, reason=reason)
+                self._finish_cancel(req, slot=None, reason=reason,
+                                    outcome=outcome)
                 return True
         if any(entry[0] == rid for entry in self._swapping):
             # close the open d2h window first: the chain either commits
@@ -1590,7 +1654,8 @@ class Scheduler:
             req, path = self.parked.pop(rid)
             if path == "swap":
                 self.host_store.pop(rid)
-            self._finish_cancel(req, slot=None, reason=reason)
+            self._finish_cancel(req, slot=None, reason=reason,
+                                outcome=outcome)
             return True
         slot = next(
             (s for s, r in self.resident.items() if r.rid == rid), None
@@ -1606,17 +1671,44 @@ class Scheduler:
         self._slot2rid.pop(slot, None)
         if self._san is not None:
             self._san.check_retire(slot, rid=rid, site="cancel")
-        self._finish_cancel(req, slot=slot, reason=reason)
+        self._finish_cancel(req, slot=slot, reason=reason,
+                            outcome=outcome)
         return True
 
+    def _expire_deadlines(self) -> None:
+        """Per-tick deadline sweep (top of every ``dispatch_tick``):
+        every live request whose absolute deadline has passed — queued,
+        mid-prefill, decoding, parked (either path), mid swap-out, or
+        handoff-ready — expires through the cancel machinery with
+        ``outcome="deadline"``. Runs before restores/admissions so an
+        expired parked request never burns a restore, and an expired
+        queue head never burns a slot."""
+        now = time.perf_counter()
+        expired = [
+            req.rid
+            for bucket in (
+                self.queue, self.resident.values(),
+                (r for r, _ in self.parked.values()),
+                (entry[1] for entry in self._swapping),
+            )
+            for req in bucket
+            if req.deadline <= now
+        ]
+        for rid in expired:
+            self.cancel(rid, reason="deadline-exceeded",
+                        outcome="deadline")
+
     def _finish_cancel(self, req: Request, slot: Optional[int],
-                       reason: str) -> None:
+                       reason: str, outcome: str = "cancelled") -> None:
         """Shared cancellation tail: counters, flight record, span-tree
         closure (every open span ends, then the root, all with
-        ``outcome="cancelled"``)."""
-        self._cancelled += 1
+        ``outcome`` — ``"cancelled"`` or ``"deadline"``)."""
+        if outcome == "deadline":
+            self._deadline_misses += 1
+        else:
+            self._cancelled += 1
         self.flightrec.record(
-            "cancel", rid=req.rid, reason=reason,
+            "cancel", rid=req.rid, reason=reason, outcome=outcome,
             slot=slot if slot is not None else -1,
             tokens=req.produced, replica=self.replica_id,
         )
@@ -1626,12 +1718,102 @@ class Scheduler:
                          "span_queue"):
                 sid = getattr(req, name)
                 if sid:
-                    self.reqtrace.end(sid, outcome="cancelled")
+                    self.reqtrace.end(sid, outcome=outcome)
                     setattr(req, name, 0)
             self.reqtrace.end(
-                self.reqtrace.root(req.rid), outcome="cancelled",
+                self.reqtrace.root(req.rid), outcome=outcome,
                 new_tokens=req.produced, reason=reason,
             )
+
+    # ---- replica death: harvest + abandon (fleet failure plane) ----
+
+    def harvest_requests(self) -> List[Request]:
+        """Every in-flight ``Request`` this replica owns — queued,
+        resident (mid-prefill, decoding, handoff-ready), parked, mid
+        swap-out — in rid order. The router's failure plane calls this
+        when the health plane declares the replica dead, BEFORE
+        ``abandon`` tears it down: the records carry everything a
+        re-dispatch needs (original prompt length, deadline, session,
+        produced count, open span ids)."""
+        reqs: Dict[int, Request] = {}
+        for req in self.queue:
+            reqs[req.rid] = req
+        for req in self.resident.values():
+            reqs[req.rid] = req
+        for rid, (req, _path) in self.parked.items():
+            reqs[rid] = req
+        for entry in self._swapping:
+            reqs[entry[0]] = entry[1]
+        return [reqs[rid] for rid in sorted(reqs)]
+
+    def abandon(self) -> None:
+        """Tear down a replica the health plane declared dead: no tick
+        of this scheduler ever runs again. The in-process analogue of
+        the OS reclaiming a crashed worker — every device chain, open
+        swap window, host-store chain, handoff pin, and queue entry is
+        disposed of through the allocator's public API, and (under
+        blocksan) the shadow ledger must agree the teardown leaked
+        nothing (``verify_quiesce``). Tokens a dead replica produced
+        but never delivered are LOST by design — the router's replay
+        regenerates them; blocks are never lost.
+
+        Each harvested request's open lifecycle spans end here with
+        ``outcome="replica-lost"``; the ROOT stays open — the router
+        decides its final outcome (re-dispatch → ``complete``, attempt
+        cap → ``failed``, expired meanwhile → ``deadline``)."""
+        if self.reqtrace.enabled:
+            for req in self.harvest_requests():
+                for name in ("span_decode", "span_prefill",
+                             "span_ready", "span_swap", "span_parked",
+                             "span_preempt", "span_queue"):
+                    sid = getattr(req, name)
+                    if sid:
+                        self.reqtrace.end(sid, outcome="replica-lost")
+                        setattr(req, name, 0)
+                self.reqtrace.event(
+                    req.rid, "replica_death", replica=self.replica_id,
+                    produced=req.produced,
+                )
+        # a launched-but-uncollected tick is never collected: a dead
+        # replica's device results are untrusted
+        self._pending_tick = None
+        self._collected.clear()
+        self._tick_obs.clear()
+        self.draining = True  # any straggler submit raises, loudly
+        # open swap-out windows: close the allocator's swap state
+        # WITHOUT committing (the d2h arrays are dropped), then the
+        # chain frees like any other
+        for entry in self._swapping:
+            slot = entry[2].slot
+            self.engine.allocator.clear_state(slot)
+            self._swap_slots.discard(slot)
+            self.engine.release(slot)
+            self._slot2rid.pop(slot, None)
+        self._swapping.clear()
+        for rid, (req, path) in self.parked.items():
+            if path == "swap":
+                self.host_store.pop(rid)
+        self.parked.clear()
+        for slot in list(self.resident):
+            req = self.resident.pop(slot)
+            self.ready.pop(req.rid, None)
+            if self._san is not None:
+                self._san.unpin(slot)
+            self.remaining[slot] = 0
+            self.engine.release(slot)
+            self._slot2rid.pop(slot, None)
+            if self._san is not None:
+                self._san.check_retire(slot, rid=req.rid,
+                                       site="abandon")
+        self.queue.clear()
+        self.positions[:] = 0
+        self.remaining[:] = 0
+        self.flightrec.record("abandon", replica=self.replica_id)
+        if self._san is not None:
+            # the teardown gate: ledger ≡ allocator, no chain, window,
+            # or pin outstanding — a dead replica may lose tokens,
+            # never blocks
+            self._san.verify_quiesce()
 
     # ---- prefill→decode handoff (fleet disaggregation) ----
 
@@ -1833,6 +2015,7 @@ class Scheduler:
             "admitted": self._admitted,
             "completed": self._completed,
             "cancelled": self._cancelled,
+            "deadline_misses": self._deadline_misses,
             **(self.blocksan.summary()
                if self.blocksan is not None else {}),
             "tokens_out": self._tokens_out,

@@ -67,6 +67,9 @@ REQUIRED_KEYS: Dict[str, FrozenSet[str]] = {
     # analysis/blocksan.py block-lifecycle sanitizer (round 18);
     # per-``ev`` shapes refined by ``_SANITIZER_EV_KEYS`` below
     "sanitizer": frozenset({"ev", "shadow", "replica_id"}),
+    # fleet/router.py replica health transitions (round 19): one record
+    # per state-machine edge (healthy/suspect/dead/draining/rejoining)
+    "health": frozenset({"replica_id", "state", "prev", "reason", "tick"}),
 }
 
 #: additional required keys per span ``ev`` (see reqtrace module docs)

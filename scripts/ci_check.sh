@@ -12,7 +12,7 @@
 #                             --pressure-smoke|--trace-smoke|
 #                             --overlap-smoke|--async-smoke|
 #                             --prefix-smoke|--blocksan-smoke|
-#                             --bench-regression]
+#                             --chaos-smoke|--bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -120,6 +120,17 @@
 # both runs' JSONLs must carry kind="sanitizer" quiesce records with
 # ok=true and ZERO violation records (the shadow ledger matched the
 # allocator even through the fault) (~40 s).
+#
+# --chaos-smoke: lint, then the round-19 replica-failure cycle: one
+# 2-replica serve under PDT_BLOCKSAN=1 with an injected serve.dispatch
+# kill (replica dies mid-flight, every stream recovers via re-dispatch)
+# plus an already-expired admission (deadline shed), streamed to JSONL —
+# then explain_request.py must find a redispatched rid by predicate,
+# render its replica-hop chain, and close its span tree, and find the
+# deadline rid's terminal outcome; the fleet_summary must carry the
+# failure-plane counters. The fast chaos grid itself rides tier-1
+# (tests/test_chaos_matrix.py, non-@slow); the full fault×state grid is
+# @slow (~30 s).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -423,6 +434,86 @@ for path in sys.argv[1:]:
           f"ok, 0 violations")
 PY
     echo "blocksan smoke OK"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--chaos-smoke" ]]; then
+    echo "== chaos smoke (replica kill -> re-dispatch + deadline shed -> explain) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python - "$smoke/chaos.jsonl" <<'PY'
+import os
+import sys
+
+os.environ["PDT_BLOCKSAN"] = "1"
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.fleet import FleetRouter
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM, tiny_config,
+)
+from pytorch_distributed_tpu.resilience import faults
+from pytorch_distributed_tpu.resilience.faults import FaultPlan, FaultSpec
+from pytorch_distributed_tpu.telemetry.reqtrace import ReqTracer
+from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+cfg = tiny_config(attention="dense", max_seq_len=96)
+params = TransformerLM(cfg).init(
+    jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+)["params"]
+mlog = MetricsLogger(sys.argv[1])
+router = FleetRouter(
+    cfg, params, n_replicas=2, n_slots=3, block_len=8, prefill_chunk=8,
+    fail_threshold=1, metrics_log=mlog, reqtrace=ReqTracer(sink=mlog),
+)
+rng = np.random.default_rng(0)
+prompts = [rng.integers(1, cfg.vocab_size, (9 + i,)).astype(np.int32)
+           for i in range(3)]
+faults.install_plan(FaultPlan([
+    FaultSpec(site="serve.dispatch", kind="raise", at=2, times=1)
+]))
+try:
+    rids = [router.submit(p, 6) for p in prompts]
+    # a request whose budget is already spent sheds at admission
+    expired = router.submit(prompts[0], 6, deadline_s=-0.01)
+    out = router.drain(max_steps=4000)
+finally:
+    faults.clear_plan()
+assert all(len(out[r]) == 6 for r in rids), "a stream did not recover"
+assert router.rejected[expired] == "deadline-expired"
+m = router.metrics()
+assert m["replica_deaths"] == 1 and m["redispatched"] >= 1, m
+router.blocksan.assert_clean()
+router.log_summary()
+mlog.close()
+print(f"chaos serve: {len(rids)} streams recovered off a dead replica, "
+      f"1 deadline shed, ledger clean")
+PY
+    JAX_PLATFORMS=cpu python scripts/explain_request.py \
+        "$smoke/chaos.jsonl" --find redispatched --assert-complete \
+        | tee "$smoke/explain.txt"
+    grep -q "replica hops:" "$smoke/explain.txt" \
+        || { echo "explain output missing the replica-hop chain"; exit 1; }
+    JAX_PLATFORMS=cpu python scripts/explain_request.py \
+        "$smoke/chaos.jsonl" --find deadline --assert-complete \
+        > "$smoke/deadline.txt"
+    grep -q "terminal outcome: DEADLINE" "$smoke/deadline.txt" \
+        || { echo "explain output missing the deadline outcome"; exit 1; }
+    python - "$smoke/chaos.jsonl" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+from pytorch_distributed_tpu.telemetry.schema import validate_stream
+assert validate_stream(rows) == [], validate_stream(rows)[:5]
+health = [r for r in rows if r.get("kind") == "health"]
+assert {"draining", "dead"} <= {r["state"] for r in health}, health
+fleet = [r for r in rows if r.get("kind") == "fleet_summary"][-1]
+assert fleet["replica_deaths"] == 1 and fleet["redispatched"] >= 1
+print(f"telemetry: {len(health)} health transitions on the wire, "
+      f"fleet_summary carries the failure plane")
+PY
+    echo "chaos smoke OK"
     exit 0
 fi
 

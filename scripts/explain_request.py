@@ -19,10 +19,13 @@ host loop):
     python scripts/explain_request.py serve.jsonl --rid 17 --assert-complete
     python scripts/explain_request.py serve.jsonl --perfetto out.trace.json
 
-``--find preempted|handed-off|shed|any`` picks the first rid whose
-trace matches the predicate — the CI smoke uses it to assert a
-preempted AND a handed-off request both left complete traces without
-hard-coding rids. ``--assert-complete`` exits non-zero unless the trace
+``--find preempted|handed-off|shed|redispatched|failed|deadline|any``
+picks the first rid whose trace matches the predicate — the CI smoke
+uses it to assert a preempted AND a handed-off request both left
+complete traces without hard-coding rids; the round-19 predicates pick
+out the failure plane (``redispatched`` = replayed off a dead replica,
+with the replica-hop chain rendered under the tree; ``failed`` /
+``deadline`` = root span closed with that terminal outcome). ``--assert-complete`` exits non-zero unless the trace
 is a closed acyclic tree: every span ended exactly once, every parent
 opened earlier in the same trace, exactly one root, no orphan events —
 the ``scripts/ci_check.sh --trace-smoke`` gate. ``--perfetto`` writes
@@ -87,6 +90,19 @@ def _trace_has(records: List[dict], rid: int, name: str,
     return False
 
 
+def _root_outcome(records: List[dict], rid: int) -> Optional[str]:
+    """The rid's terminal outcome: the ``outcome`` attribute on the end
+    record of its root span (``name="request"``, no parent). None when
+    the root never closed — the trace is still open or torn."""
+    recs = span_records(records, rid)
+    roots = {r["span"] for r in recs
+             if r.get("ev") == "begin" and r.get("name") == "request"}
+    for r in recs:
+        if r.get("ev") == "end" and r.get("span") in roots:
+            return r.get("outcome")
+    return None
+
+
 FINDERS = {
     "preempted": lambda recs, rid: (
         _trace_has(recs, rid, "preempt")
@@ -94,6 +110,11 @@ FINDERS = {
     ),
     "handed-off": lambda recs, rid: _trace_has(recs, rid, "handoff"),
     "shed": lambda recs, rid: _trace_has(recs, rid, "gate", action="shed"),
+    # round-19 failure plane: requests that died with their replica and
+    # replayed elsewhere, exhausted the attempt cap, or missed their SLO
+    "redispatched": lambda recs, rid: _trace_has(recs, rid, "redispatch"),
+    "failed": lambda recs, rid: _root_outcome(recs, rid) == "failed",
+    "deadline": lambda recs, rid: _root_outcome(recs, rid) == "deadline",
     "any": lambda recs, rid: True,
 }
 
@@ -272,6 +293,24 @@ def explain(records: List[dict], rid: int, out=None) -> int:
                    else "? (no measured chunk wall yet)")
                 + (f"; measured swap {_fmt_ms(measured)}" if swaps else "")
             )
+    # round-19 failure plane: the replica-hop chain — each hop is a
+    # replica death that replayed this request elsewhere (``replayed``
+    # counts already-delivered tokens re-prefilled, not regenerated)
+    hops = [r for r in recs
+            if r.get("ev") == "event" and r.get("name") == "redispatch"]
+    if hops:
+        chain = f"r{hops[0].get('src')}"
+        for h in hops:
+            chain += (f" ✝→ r{h.get('dst')} (attempt {h.get('attempt')},"
+                      f" replayed {h.get('replayed')} tok)")
+        lines.append(f"replica hops: {chain}")
+    outcome = _root_outcome(records, rid)
+    if outcome == "failed":
+        lines.append("terminal outcome: FAILED — re-dispatch attempt "
+                     "cap exhausted; the stream never completed")
+    elif outcome == "deadline":
+        lines.append("terminal outcome: DEADLINE — the request's SLO "
+                     "budget lapsed before completion")
     for e in errors:
         lines.append(f"INCOMPLETE: {e}")
     print("\n".join(lines), file=out)

@@ -71,3 +71,17 @@ class SwapWindow:
         finally:
             self.allocator.clear_state(slot)
         return blocks
+
+
+class ChaoslessServer:
+    # this fixture tree carries no tests/test_chaos_matrix.py, so any
+    # serve-side site here is by definition unexercised by the grid
+    def dispatch_tick(self):
+        fault_point("serve.reorder_buffer")  # EXPECT: lifecycle-fault-site-untested
+        return self.work()
+
+    def swap_in(self, slot):
+        # non-serve sites are the kill matrix's jurisdiction, not the
+        # chaos matrix's: only serve.* requires a chaos entry
+        fault_point("kv.swap_in_h2d")  # CLEAN: lifecycle-fault-site-untested
+        return self.h2d(slot)

@@ -1,0 +1,638 @@
+"""Chaos matrix (round 19 tentpole): replica-failure tolerance.
+
+Fault × request-state grid over the serve-side fault sites
+(``serve.dispatch`` / ``serve.collect`` / ``serve.handoff_export`` /
+``serve.handoff_import``; kinds ``raise`` and ``hang``): whatever dies,
+every submitted request must FINISH (token-identical to a fault-free
+run), SHED with ``outcome="failed"`` (attempt cap), or EXPIRE with
+``outcome="deadline"`` — never hang. Each scenario also proves the
+teardown leak-free (blocksan shadow ledger, zero violations) and the
+request traces closed (``validate_trace`` empty). The fast subset here
+is tier-1; the full grid is ``@slow``. Deadline enforcement gets its own
+state matrix: a request whose deadline lapses while queued, mid-prefill,
+decoding, parked, mid-swap, or handoff-ready must expire through the
+round-18 cancel path with ``outcome="deadline"``.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.resilience import faults
+from pytorch_distributed_tpu.resilience.faults import FaultPlan, FaultSpec
+from pytorch_distributed_tpu.serving import Scheduler
+from pytorch_distributed_tpu.telemetry.flightrec import FlightRecorder
+from pytorch_distributed_tpu.telemetry.reqtrace import (
+    ReqTracer,
+    validate_trace,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(attention="dense", max_seq_len=96)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def _prompts(cfg, n=3, base=9, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, cfg.vocab_size, (base + i,)).astype(np.int32)
+        for i in range(n)
+    ]
+
+
+def _fleet(cfg, params, monkeypatch, **over):
+    """A blocksan-armed FleetRouter with an in-memory request tracer —
+    every chaos scenario runs under both proof layers."""
+    from pytorch_distributed_tpu.fleet import FleetRouter
+
+    monkeypatch.setenv("PDT_BLOCKSAN", "1")
+    kw = dict(n_replicas=2, n_slots=3, block_len=8, prefill_chunk=8,
+              reqtrace=ReqTracer(), flightrec=FlightRecorder())
+    kw.update(over)
+    return FleetRouter(cfg, params, **kw)
+
+
+def _assert_proofs(router):
+    """The per-scenario gate: zero leaked blocks and closed span trees."""
+    router.blocksan.assert_clean()
+    assert validate_trace(router.reqtrace.records) == []
+
+
+def _run(router, prompts, max_new=6, plan=None, deadline_s=None,
+         max_steps=4000):
+    if plan is not None:
+        faults.install_plan(plan)
+    try:
+        rids = [router.submit(p, max_new, deadline_s=deadline_s)
+                for p in prompts]
+        out = router.drain(max_steps=max_steps)
+    finally:
+        if plan is not None:
+            faults.clear_plan()
+    return rids, out
+
+
+# ---------------------------------------------------------------------------
+# fast subset (tier-1): one kill per fault class + the core guarantees
+# ---------------------------------------------------------------------------
+
+
+def test_redispatch_streams_identical_to_fault_free(model, monkeypatch):
+    """THE recovery gate: kill a replica mid-flight; every request's
+    greedy stream must be token-identical to the fault-free run — the
+    replay re-prefills original prompt + delivered tokens, so clients
+    observe append-only streams with no divergence."""
+    cfg, params = model
+    prompts = _prompts(cfg)
+    ref_router = _fleet(cfg, params, monkeypatch)
+    ref_rids, ref_out = _run(ref_router, prompts)
+    ref = {rid: ref_out[rid] for rid in ref_rids}
+    assert all(len(v) == 6 for v in ref.values())
+    _assert_proofs(ref_router)
+
+    router = _fleet(cfg, params, monkeypatch, fail_threshold=1)
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="raise", at=2, times=1)
+    ])
+    rids, out = _run(router, prompts, plan=plan)
+    assert plan.fired == [("serve.dispatch", 2, "raise")]
+    m = router.metrics()
+    assert m["replica_deaths"] == 1 and m["replicas_healthy"] == 1
+    assert m["redispatched"] >= 1 and m["failed"] == 0
+    assert {rid: out[rid] for rid in rids} == ref
+    assert "dead" in [h["state"] for h in router.health]
+    _assert_proofs(router)
+    # the health transitions are flight-recorder facts, not just state
+    deaths = [r for r in router.flightrec.snapshot()
+              if r.get("kind") == "health" and r.get("state") == "dead"]
+    assert len(deaths) == 1
+
+
+@pytest.mark.parametrize("site,n_replicas", [
+    ("serve.collect", 2),
+    ("serve.handoff_export", 2),
+    ("serve.handoff_import", 3),
+])
+def test_transient_fault_marks_suspect_then_recovers(
+        model, monkeypatch, site, n_replicas):
+    """One injected failure below ``fail_threshold``: the replica goes
+    suspect, the next clean touch clears it, and every request still
+    finishes — a single blip is a warning, not a death sentence."""
+    cfg, params = model
+    disagg = site.startswith("serve.handoff")
+    router = _fleet(cfg, params, monkeypatch, n_replicas=n_replicas,
+                    disaggregate=disagg, fail_threshold=2)
+    plan = FaultPlan([FaultSpec(site=site, kind="raise", at=0, times=1)])
+    rids, out = _run(router, _prompts(cfg), max_new=4, plan=plan)
+    assert plan.fired
+    assert all(len(out[rid]) == 4 for rid in rids)
+    assert all(h["state"] == "healthy" for h in router.health)
+    assert sum(h["failures"] for h in router.health) == 1
+    assert router.metrics()["replica_deaths"] == 0
+    _assert_proofs(router)
+
+
+@pytest.mark.slow
+def test_hang_overrunning_tick_deadline_condemns(model, monkeypatch):
+    """The hang kind: the tick returns late instead of raising — the
+    tick deadline must condemn the replica exactly like a crash, and
+    the fleet recovers identically. Warmed first: the deadline
+    presumes compiled replicas (a compile IS a legitimate stall)."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch, tick_deadline_s=0.25)
+    router.warmup()
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="hang", at=2, times=1,
+                  seconds=0.3)
+    ])
+    rids, out = _run(router, _prompts(cfg), plan=plan)
+    assert plan.fired == [("serve.dispatch", 2, "hang")]
+    states = [h["state"] for h in router.health]
+    assert states.count("dead") == 1
+    assert all(len(out[rid]) == 6 for rid in rids)
+    assert any(
+        str(r.get("reason", "")).startswith("tick-hang")
+        for r in router.flightrec.snapshot()
+        if r.get("kind") == "health"
+    ), "condemnation reason should name the hang"
+    _assert_proofs(router)
+
+
+@pytest.mark.slow
+def test_attempt_cap_sheds_with_outcome_failed(model, monkeypatch):
+    """Serial replica deaths exhaust the re-dispatch budget: a request
+    harvested TWICE sheds with ``outcome="failed"`` (root span closes
+    with that outcome) instead of retrying forever, while a request
+    harvested only once keeps WAITING through the fleet-wide outage —
+    a later revive still delivers its full stream."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch, fail_threshold=1,
+                    redispatch_max_attempts=1,
+                    redispatch_base_delay_s=0.0)
+    # idx 2 is r0's tick-1 dispatch (kills r0); the survivors replay
+    # onto r1, and idx 6 — r1's third solo tick — kills it too
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="raise", at=2, times=1),
+        FaultSpec(site="serve.dispatch", kind="raise", at=6, times=1),
+    ])
+    faults.install_plan(plan)
+    try:
+        rids = [router.submit(p, 6) for p in _prompts(cfg)]
+        for _ in range(12):
+            router.step()
+    finally:
+        faults.clear_plan()
+    assert len(plan.fired) == 2
+    m = router.metrics()
+    assert m["replica_deaths"] == 2 and m["replicas_healthy"] == 0
+    assert m["failed"] >= 1
+    assert set(router.failed) <= set(rids)
+    roots = [r for r in router.reqtrace.records
+             if r.get("ev") == "end" and r.get("outcome") == "failed"]
+    assert len(roots) == len(router.failed)
+    # requests with attempts left are held, not dropped: the whole
+    # fleet is dead, so they wait for a revive
+    waiting = sorted(e["rid"] for e in router._pending_redispatch)
+    assert set(waiting) == set(rids) - set(router.failed)
+    assert not router.idle
+    router.revive(0)
+    out = router.drain(max_steps=4000)
+    for rid in waiting:
+        assert len(out[rid]) == 6
+    _assert_proofs(router)
+
+
+def test_kill_with_parked_and_midswap_requests(model, monkeypatch):
+    """The hard harvest states: the dying replica holds a PARKED
+    (swapped-out) request and one MID-SWAP (open swap window). Abandon
+    must close the window without committing, free every chain, and
+    the replay must still deliver full streams."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch, fail_threshold=1,
+                    offload=True, swap_policy="swap", protect_ticks=0)
+    prompts = _prompts(cfg, n=2)
+    rids = [router.submit(p, 8) for p in prompts]
+    for _ in range(3):
+        router.step()
+    victim_replica = router.placement[rids[0]]
+    s = router.replicas[victim_replica]
+    assert s.preempt(rids[0], reason="chaos").choice == "swap"
+    # rids[0] now sits in the open swap window (_swapping) — kill the
+    # victim replica BEFORE its next dispatch tick finalizes the swap
+    # (serve.dispatch fires before any tick work, so the harvest sees
+    # the window open). The step order is the alive fleet order, so
+    # the victim's dispatch index within the next step is its position
+    # in that order.
+    order = router._alive(router.decode_group + router.entry_group)
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="raise",
+                  at=order.index(victim_replica), times=1)
+    ])
+    faults.install_plan(plan)
+    try:
+        router.step()
+    finally:
+        faults.clear_plan()
+    assert plan.fired
+    assert router.health[victim_replica]["state"] == "dead"
+    out = router.drain(max_steps=4000)
+    assert all(len(out[rid]) == 8 for rid in rids), {
+        k: len(out.get(k, ())) for k in rids
+    }
+    _assert_proofs(router)
+
+
+@pytest.mark.slow
+def test_revive_behind_warmup_no_recompiles_no_drops(model, monkeypatch):
+    """Degraded operation + recovery: kill a replica, serve degraded,
+    then revive it behind compile-cache warmup — survivors never
+    recompile (program-name fingerprint), the rejoined replica takes
+    traffic, and no request drops across the whole episode."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch, fail_threshold=1)
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="raise", at=2, times=1)
+    ])
+    rids, out = _run(router, _prompts(cfg), plan=plan)
+    assert all(len(out[rid]) == 6 for rid in rids)
+    dead = [i for i, h in enumerate(router.health)
+            if h["state"] == "dead"]
+    assert len(dead) == 1
+    fingerprints = {
+        i: tuple(s.engine.compiled_program_names())
+        for i, s in enumerate(router.replicas) if i not in dead
+    }
+    router.revive(dead[0], warmup=True)
+    assert router.health[dead[0]]["state"] == "healthy"
+    rid2 = router.submit(_prompts(cfg, n=1, base=12)[0], 4)
+    out2 = router.drain(max_steps=2000)
+    assert len(out2[rid2]) == 4
+    for i, fp in fingerprints.items():
+        assert tuple(
+            router.replicas[i].engine.compiled_program_names()
+        ) == fp, f"survivor r{i} recompiled across the revive"
+    router.assert_registry_covers()
+    _assert_proofs(router)
+
+
+@pytest.mark.slow
+def test_prefill_death_waits_for_revive_in_disagg(model, monkeypatch):
+    """Disaggregated fleet with ONE prefill replica: its death leaves
+    no entry survivor, so harvested requests WAIT (the fleet is
+    explicitly not idle) and a revive drains them — no silent drop,
+    no bogus completion."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch, disaggregate=True,
+                    fail_threshold=1)
+    # entry ticks are the odd site indices (decode group ticks first)
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="raise", at=3, times=1)
+    ])
+    faults.install_plan(plan)
+    try:
+        rids = [router.submit(p, 4) for p in _prompts(cfg)]
+        for _ in range(8):
+            router.step()
+    finally:
+        faults.clear_plan()
+    assert router.health[0]["state"] == "dead"
+    assert not router.idle  # pending re-dispatch IS in-flight work
+    assert router.metrics()["redispatch_pending"] >= 1
+    # a fresh submit while no entry replica is alive sheds explicitly
+    shed_rid = router.submit(_prompts(cfg, n=1)[0], 4)
+    assert router.rejected[shed_rid] == "fleet-unavailable"
+    router.revive(0)
+    out = router.drain(max_steps=4000)
+    assert all(len(out[rid]) == 4 for rid in rids)
+    _assert_proofs(router)
+
+
+# ---------------------------------------------------------------------------
+# deadline enforcement: expiry in every request state
+# ---------------------------------------------------------------------------
+
+
+def _deadline_scheduler(cfg, params, **over):
+    from pytorch_distributed_tpu.analysis.blocksan import BlockSanitizer
+
+    kw = dict(n_slots=2, block_len=8, prefill_chunk=8, offload=True,
+              swap_policy="swap", protect_ticks=0,
+              blocksan=BlockSanitizer(), reqtrace=ReqTracer())
+    kw.update(over)
+    return Scheduler(cfg, params, **kw)
+
+
+def _expire_here(s, rid, state_key):
+    """Assert rid currently sits in ``state_key``, then force its
+    deadline into the past and tick once — it must expire with
+    outcome=deadline. The expiry is forced through the live ``Request``
+    record (``harvest_requests`` is a read-only traversal of every
+    bucket) rather than by sleeping: the first ticks JIT-compile, so a
+    wall-clock budget would race the compiler."""
+    assert rid in s.stuck_rids().get(state_key, []), (
+        state_key, s.stuck_rids()
+    )
+    before = s.metrics()["deadline_misses"]
+    req = next(r for r in s.harvest_requests() if r.rid == rid)
+    req.deadline = 0.0  # perf_counter epoch: unambiguously lapsed
+    s.step()
+    assert s.metrics()["deadline_misses"] == before + 1
+    ends = [r for r in s.reqtrace.records
+            if r.get("ev") == "end" and r.get("outcome") == "deadline"]
+    assert ends, "no span closed with outcome=deadline"
+
+
+def test_deadline_expires_queued(model):
+    cfg, params = model
+    s = _deadline_scheduler(cfg, params, n_slots=1)
+    a = s.submit(np.arange(1, 9, dtype=np.int32), 64)
+    b = s.submit(np.arange(2, 12, dtype=np.int32), 4, deadline_s=30.0)
+    s.step()
+    _expire_here(s, b, "queued")
+    s.cancel(a)
+    s.drain()
+    assert s._san.verify_quiesce() == []
+    assert validate_trace(s.reqtrace.records) == []
+
+
+def test_deadline_expires_mid_prefill(model):
+    cfg, params = model
+    s = _deadline_scheduler(cfg, params)
+    # 3 chunks of prefill; expire after the first
+    rid = s.submit(np.arange(1, 21, dtype=np.int32), 4, deadline_s=30.0)
+    s.step()
+    _expire_here(s, rid, "prefill")
+    s.drain()
+    assert s._san.verify_quiesce() == []
+    assert validate_trace(s.reqtrace.records) == []
+
+
+def test_deadline_expires_decoding(model):
+    cfg, params = model
+    s = _deadline_scheduler(cfg, params)
+    rid = s.submit(np.arange(1, 9, dtype=np.int32), 64, deadline_s=30.0)
+    for _ in range(3):
+        s.step()
+    _expire_here(s, rid, "decoding")
+    s.drain()
+    assert s._san.verify_quiesce() == []
+    assert validate_trace(s.reqtrace.records) == []
+
+
+def test_deadline_expires_parked(model):
+    cfg, params = model
+    s = _deadline_scheduler(cfg, params)
+    rid = s.submit(np.arange(1, 9, dtype=np.int32), 64, deadline_s=30.0)
+    for _ in range(3):
+        s.step()
+    assert s.preempt(rid, reason="test").choice == "swap"
+    # hold it parked across ticks: every restore attempt aborts at the
+    # h2d hazard (host copy intact, retried), so the free slot cannot
+    # pull the request back to decoding before the deadline sweep sees
+    # it in the parked state
+    faults.install_plan(FaultPlan([
+        FaultSpec(site="kv.swap_in_h2d", kind="raise", at=0, times=64)
+    ]))
+    try:
+        s.step()  # finalizes the swap-out; the restore aborts → parked
+        _expire_here(s, rid, "parked")
+    finally:
+        faults.clear_plan()
+    s.drain()
+    assert s._san.verify_quiesce() == []
+    assert validate_trace(s.reqtrace.records) == []
+
+
+def test_deadline_expires_mid_swap(model):
+    cfg, params = model
+    s = _deadline_scheduler(cfg, params)
+    rid = s.submit(np.arange(1, 9, dtype=np.int32), 64, deadline_s=30.0)
+    for _ in range(3):
+        s.step()
+    assert s.preempt(rid, reason="test").choice == "swap"
+    # the swap window is OPEN (not yet finalized by the next tick)
+    _expire_here(s, rid, "swapping")
+    s.drain()
+    assert s._san.verify_quiesce() == []
+    assert validate_trace(s.reqtrace.records) == []
+
+
+def test_deadline_expires_handoff_ready(model):
+    cfg, params = model
+    s = _deadline_scheduler(cfg, params, prefill_only=True, handoff=True,
+                            offload=False, swap_policy="recompute")
+    rid = s.submit(np.arange(1, 9, dtype=np.int32), 4, deadline_s=30.0)
+    for _ in range(3):
+        s.step()
+    _expire_here(s, rid, "handoff-ready")
+    s.drain()
+    assert s._san.verify_quiesce() == []
+    assert validate_trace(s.reqtrace.records) == []
+
+
+def test_deadline_sheds_at_admission(model, monkeypatch):
+    """Admission is the FIRST enforcement point: an already-expired
+    budget never touches a replica; the root closes outcome=deadline
+    and the router counts a deadline shed, not a generic one."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch)
+    rid = router.submit(_prompts(cfg, n=1)[0], 4, deadline_s=-0.01)
+    assert router.rejected[rid] == "deadline-expired"
+    m = router.metrics()
+    assert m["deadline_sheds"] == 1 and m["shed"] == 1
+    router.drain()
+    ends = [r for r in router.reqtrace.records
+            if r.get("ev") == "end" and r.get("outcome") == "deadline"]
+    assert len(ends) == 1
+    _assert_proofs(router)
+
+
+@pytest.mark.slow
+def test_deadline_survives_redispatch_unchanged(model, monkeypatch):
+    """Replica loss must not grant a fresh latency budget: the absolute
+    deadline travels with the replay, and a harvested request whose
+    deadline lapses while waiting expires with outcome=deadline."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch, disaggregate=True,
+                    fail_threshold=1, redispatch_base_delay_s=0.01)
+    router.warmup()  # ticks must be compile-free for a sub-second SLO
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="raise", at=3, times=1)
+    ])
+    faults.install_plan(plan)
+    try:
+        rids = [router.submit(p, 4, deadline_s=0.5)
+                for p in _prompts(cfg, n=2)]
+        for _ in range(6):
+            router.step()
+    finally:
+        faults.clear_plan()
+    assert router.health[0]["state"] == "dead"
+    assert router.metrics()["redispatch_pending"] >= 1
+    # no entry survivor: the deadline keeps ticking while they wait
+    time.sleep(0.55)
+    router.step()
+    m = router.metrics()
+    assert m["deadline_expired_redispatch"] >= 1
+    assert router.idle  # expired entries leave no pending work behind
+    for rid in rids:
+        assert len(router.results.get(rid, ())) < 4
+    _assert_proofs(router)
+
+
+# ---------------------------------------------------------------------------
+# drain diagnostics + health telemetry (satellites)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_drain_names_stuck_rids(model, monkeypatch):
+    cfg, params = model
+    s = _deadline_scheduler(cfg, params, n_slots=1)
+    a = s.submit(np.arange(1, 9, dtype=np.int32), 4)
+    b = s.submit(np.arange(1, 12, dtype=np.int32), 4)
+    s.step()
+    with pytest.raises(RuntimeError) as exc:
+        s.drain(max_steps=0)
+    assert "stuck rids by state" in str(exc.value)
+    assert str(b) in str(exc.value)
+    s.drain()
+
+    router = _fleet(cfg, params, monkeypatch, disaggregate=True,
+                    fail_threshold=1)
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="raise", at=3, times=1)
+    ])
+    faults.install_plan(plan)
+    try:
+        rid = router.submit(_prompts(cfg, n=1)[0], 4)
+        for _ in range(6):
+            router.step()
+        with pytest.raises(RuntimeError) as exc:
+            router.drain(max_steps=3)
+    finally:
+        faults.clear_plan()
+    assert "awaiting redispatch" in str(exc.value)
+    assert str(rid) in str(exc.value)
+    router.revive(0)
+    router.drain()
+
+
+def test_health_and_redispatch_jsonl_schema(model, tmp_path, monkeypatch):
+    """kind="health" records and the failure-plane fleet_summary keys
+    stream schema-valid JSONL — the telemetry contract (satellite 6)."""
+    from pytorch_distributed_tpu.telemetry.schema import validate_stream
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    cfg, params = model
+    path = tmp_path / "chaos.jsonl"
+    mlog = MetricsLogger(str(path))
+    monkeypatch.setenv("PDT_BLOCKSAN", "1")
+    from pytorch_distributed_tpu.fleet import FleetRouter
+
+    router = FleetRouter(cfg, params, n_replicas=2, n_slots=3,
+                         block_len=8, prefill_chunk=8, fail_threshold=1,
+                         metrics_log=mlog)
+    plan = FaultPlan([
+        FaultSpec(site="serve.dispatch", kind="raise", at=2, times=1)
+    ])
+    rids, out = _run(router, _prompts(cfg), plan=plan)
+    assert all(len(out[rid]) == 6 for rid in rids)
+    router.log_summary()
+    mlog.close()
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert validate_stream(records) == []
+    health = [r for r in records if r.get("kind") == "health"]
+    states = [r["state"] for r in health]
+    # the full condemnation arc is on the wire: draining then dead
+    assert "draining" in states and "dead" in states
+    fleet = [r for r in records if r.get("kind") == "fleet_summary"][-1]
+    assert fleet["replica_deaths"] == 1
+    assert fleet["redispatched"] >= 1
+    assert fleet["deadline_misses"] == 0
+    assert fleet["replicas_healthy"] == 1
+    assert fleet["r0_health"] in ("dead", "healthy")
+    router.blocksan.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# the full grid (@slow): every serve site × phase, raise + hang kinds
+# ---------------------------------------------------------------------------
+
+
+_GRID_SITES = [
+    ("serve.dispatch", False),
+    ("serve.collect", False),
+    ("serve.handoff_export", True),
+    ("serve.handoff_import", True),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("at", [0, 3, 7], ids=["early", "mid", "late"])
+@pytest.mark.parametrize(
+    "site,disagg", _GRID_SITES, ids=[s.split(".")[1] for s, _ in _GRID_SITES]
+)
+def test_chaos_grid_raise(model, monkeypatch, site, disagg, at):
+    """The full raise grid: a replica death at every serve fault site,
+    injected early (queued/prefill), mid (decoding), and late — every
+    request finishes or sheds, never hangs; ledger clean; traces
+    closed. Survivor-less episodes revive and still finish."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch,
+                    n_replicas=3 if disagg else 2,
+                    disaggregate=disagg, fail_threshold=1,
+                    redispatch_base_delay_s=0.005)
+    plan = FaultPlan([FaultSpec(site=site, kind="raise", at=at, times=1)])
+    faults.install_plan(plan)
+    try:
+        rids = [router.submit(p, 5) for p in _prompts(cfg, n=4)]
+        for _ in range(64):
+            router.step()
+            if router.idle:
+                break
+        if not router.idle and not router._alive(router.entry_group):
+            for i, h in enumerate(router.health):
+                if h["state"] == "dead":
+                    router.revive(i, warmup=False)
+        out = router.drain(max_steps=4000)
+    finally:
+        faults.clear_plan()
+    delivered = {rid: len(out.get(rid, ())) for rid in rids}
+    finished = {rid for rid, n in delivered.items() if n == 5}
+    shed = set(router.failed) | set(router.rejected)
+    assert finished | shed == set(rids), (delivered, router.failed)
+    _assert_proofs(router)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", ["serve.dispatch", "serve.collect"])
+def test_chaos_grid_hang(model, monkeypatch, site):
+    """The hang half of the grid: a wedged tick at each loop-side site
+    condemns via the tick deadline; recovery then matches the raise
+    path bit for bit."""
+    cfg, params = model
+    router = _fleet(cfg, params, monkeypatch, tick_deadline_s=0.25,
+                    redispatch_base_delay_s=0.005)
+    router.warmup()
+    plan = FaultPlan([
+        FaultSpec(site=site, kind="hang", at=2, times=1, seconds=0.3)
+    ])
+    rids, out = _run(router, _prompts(cfg, n=4), max_new=5, plan=plan)
+    assert plan.fired
+    assert [h["state"] for h in router.health].count("dead") == 1
+    assert all(len(out[rid]) == 5 for rid in rids)
+    _assert_proofs(router)

@@ -96,6 +96,7 @@ def test_v2_severities():
     assert by_rule["lifecycle-alloc-leak"].severity == "error"
     assert by_rule["lifecycle-refcount-outside-allocator"].severity == "error"
     assert by_rule["lifecycle-span-imbalance"].severity == "warning"
+    assert by_rule["lifecycle-fault-site-untested"].severity == "error"
 
 
 # ---- fingerprints ----------------------------------------------------------
@@ -151,7 +152,8 @@ def test_cli_list_rules_includes_v2_families():
                  "thread-unsynced-mutation", "thread-blocking-signal",
                  "lifecycle-alloc-leak",
                  "lifecycle-refcount-outside-allocator",
-                 "lifecycle-span-imbalance"):
+                 "lifecycle-span-imbalance",
+                 "lifecycle-fault-site-untested"):
         assert rule in res.stdout, rule
 
 
@@ -351,6 +353,54 @@ def test_cli_max_seconds_exceeded_exit_code():
     res = _cli("--no-partition-coverage", "--max-seconds", "0.000001", PKG)
     assert res.returncode == 3
     assert "exceeded" in res.stderr
+
+
+# ---- lifecycle-fault-site-untested (round 19) ------------------------------
+
+
+def test_fault_site_untested_tracks_chaos_matrix(tmp_path):
+    """A serve fault site flags until the chaos matrix names it; adding
+    the site string to tests/test_chaos_matrix.py silences the rule —
+    the lint edge of the 'every fault site has a chaos entry' contract.
+    Each repo root is probed independently (cached per chaos file)."""
+    repo = tmp_path / "repo"
+    (repo / "pkg").mkdir(parents=True)
+    mod = repo / "pkg" / "loop.py"
+    mod.write_text(
+        "def tick(self):\n"
+        "    fault_point(\"serve.reorder\")\n"
+        "    return self.work()\n"
+    )
+    # no chaos file at all: the site flags
+    findings = run_lint([str(repo)], rel_root=str(repo))
+    mine = [f for f in findings
+            if f.rule == "lifecycle-fault-site-untested"]
+    assert len(mine) == 1 and mine[0].line == 2
+    assert "serve.reorder" in mine[0].message
+    # a chaos file that names OTHER sites still flags this one
+    (repo / "tests").mkdir()
+    chaos = repo / "tests" / "test_chaos_matrix.py"
+    chaos.write_text("SITES = ['serve.dispatch']\n")
+    findings = run_lint([str(repo)], rel_root=str(repo))
+    assert any(f.rule == "lifecycle-fault-site-untested"
+               for f in findings)
+    # naming the site satisfies the contract
+    chaos.write_text("SITES = ['serve.dispatch', 'serve.reorder']\n")
+    findings = run_lint([str(repo)], rel_root=str(repo))
+    assert not any(f.rule == "lifecycle-fault-site-untested"
+                   for f in findings), [f.render() for f in findings]
+
+
+def test_shipped_serve_sites_all_have_chaos_entries():
+    """The live contract on the real tree: every serve fault_point in
+    the scheduler/engine is named by the shipped chaos matrix."""
+    from pytorch_distributed_tpu.analysis import rules_lifecycle as rl
+
+    for rel in ("serving/scheduler.py", "serving/engine.py"):
+        mod = parse_file(os.path.join(PKG, rel), REPO)
+        findings = rl.check_lifecycle(mod, None)
+        assert not any(f.rule == "lifecycle-fault-site-untested"
+                       for f in findings), [f.render() for f in findings]
 
 
 # ---- shipped-tree regression gates -----------------------------------------
