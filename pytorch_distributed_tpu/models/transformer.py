@@ -110,6 +110,14 @@ class TransformerConfig:
     # into the config, which also folds it into the registry run
     # fingerprint.
     gather_impl: str = "dense"
+    # Flash-decoding split (ops.paged_flash, round 20): the pallas
+    # gather's chain sweep splits across this many grid workers with a
+    # cross-worker log-sum-exp merge. None = auto (split when W/B
+    # crosses ops.paged_flash.SPLIT_THRESHOLD), 1 = single-worker
+    # sweep, S > 1 = forced. Serving constructors replace it into the
+    # config (split_s=) like gather_impl, so the registry fingerprint
+    # keys the program shape; dense gathers and training ignore it.
+    split_s: Optional[int] = None
 
     def __post_init__(self):
         if self.ring_layout not in ("contiguous", "zigzag"):
@@ -195,6 +203,13 @@ class TransformerConfig:
             raise ValueError(
                 f"gather_impl {self.gather_impl!r} must be 'dense' or "
                 "'pallas' (ops.attention.paged_attention spellings)"
+            )
+        if self.split_s is not None and (
+            not isinstance(self.split_s, int) or self.split_s < 1
+        ):
+            raise ValueError(
+                f"split_s {self.split_s!r} must be None (auto) or an "
+                "int >= 1 (flash-decoding worker count; ops.paged_flash)"
             )
 
     def uses_vocab_parallel(self) -> bool:
@@ -325,40 +340,62 @@ class Attention(nn.Module):
             # request (each owns its blocks); the engine routes inactive
             # slots' writes to the trash block, where duplicate hits are
             # harmless garbage.
-            if ck.value.dtype == jnp.int8:
-                # int8 quantized pool (serving.kv_pool kv_dtype="int8"):
-                # quantize-on-scatter — each written KV row stores int8
-                # values plus its per-head fp32 scale in the scale
-                # siblings, at the same (block, offset) indices. The
-                # read path below dequantizes (in-VMEM for the pallas
-                # spelling). Intra-chunk attention therefore also reads
-                # quantized KV — the same values every later chunk and
-                # decode tick will see, so the stream has ONE consistent
-                # quantization, not an exact-then-quantized seam.
-                from pytorch_distributed_tpu.serving.kv_pool import (
-                    quantize_kv,
-                )
+            from pytorch_distributed_tpu.serving.kv_pool import (
+                is_quantized_pool,
+            )
 
+            if is_quantized_pool(ck.value.dtype):
+                # quantized pool (serving.kv_pool kv_dtype="int8"/
+                # "fp8"/"fp8_e5m2"): quantize-on-scatter — each written
+                # KV row stores quantized values plus its per-head scale
+                # (fp32 multiplier for int8, int8 exponent for fp8) in
+                # the scale siblings, at the same (block, offset)
+                # indices. The read path below dequantizes (in-VMEM for
+                # the pallas spelling). Intra-chunk attention therefore
+                # also reads quantized KV — the same values every later
+                # chunk and decode tick will see, so the stream has ONE
+                # consistent quantization, not an exact-then-quantized
+                # seam. With gather_impl="pallas" the scatter fuses too
+                # (ops.paged_flash.paged_quantize_scatter computes the
+                # scales inside the write); the jnp spelling below is
+                # the dense/interpret reference — both call
+                # kv_pool.quantize_rows, so the pools are bit-identical
+                # across spellings.
                 cks = self.variable("cache", "key_scale", _need_pool)
                 cvs = self.variable("cache", "value_scale", _need_pool)
-                kq, ks_rows = quantize_kv(k)
-                vq, vs_rows = quantize_kv(v)
-                rows = (blk.reshape(-1), off.reshape(-1))
-                ck.value = ck.value.at[rows].set(
-                    kq.reshape(b * l, kv_heads, head_dim)
-                )
-                cv.value = cv.value.at[rows].set(
-                    vq.reshape(b * l, kv_heads, head_dim)
-                )
-                cks.value = cks.value.at[rows].set(
-                    ks_rows.reshape(b * l, kv_heads)
-                )
-                cvs.value = cvs.value.at[rows].set(
-                    vs_rows.reshape(b * l, kv_heads)
-                )
+                if cfg.gather_impl == "pallas":
+                    from pytorch_distributed_tpu.ops.paged_flash import (
+                        paged_quantize_scatter,
+                    )
+
+                    (ck.value, cv.value, cks.value,
+                     cvs.value) = paged_quantize_scatter(
+                        k, v, blk, off, ck.value, cv.value,
+                        cks.value, cvs.value,
+                    )
+                else:
+                    from pytorch_distributed_tpu.serving.kv_pool import (
+                        quantize_kv,
+                    )
+
+                    kq, ks_rows = quantize_kv(k, ck.value.dtype)
+                    vq, vs_rows = quantize_kv(v, cv.value.dtype)
+                    rows = (blk.reshape(-1), off.reshape(-1))
+                    ck.value = ck.value.at[rows].set(
+                        kq.reshape(b * l, kv_heads, head_dim)
+                    )
+                    cv.value = cv.value.at[rows].set(
+                        vq.reshape(b * l, kv_heads, head_dim)
+                    )
+                    cks.value = cks.value.at[rows].set(
+                        ks_rows.reshape(b * l, kv_heads)
+                    )
+                    cvs.value = cvs.value.at[rows].set(
+                        vs_rows.reshape(b * l, kv_heads)
+                    )
                 out = paged_attention(
                     q, ck.value, cv.value, block_tables, p,
-                    gather_impl=cfg.gather_impl,
+                    gather_impl=cfg.gather_impl, split_s=cfg.split_s,
                     k_scale=cks.value, v_scale=cvs.value,
                 )
             else:
@@ -370,7 +407,7 @@ class Attention(nn.Module):
                 )
                 out = paged_attention(
                     q, ck.value, cv.value, block_tables, p,
-                    gather_impl=cfg.gather_impl,
+                    gather_impl=cfg.gather_impl, split_s=cfg.split_s,
                 )
             out = nn.DenseGeneral(
                 e, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,

@@ -160,6 +160,7 @@ def paged_attention(
     gather_impl: str = "dense",
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    split_s: Optional[int] = None,
 ) -> jax.Array:
     """Decode/chunk-prefill attention against a block-pooled KV cache.
 
@@ -198,14 +199,26 @@ def paged_attention(
         enumeration (``compilecache.serving_registry`` over
         ``PagedEngine.chunk_buckets``) covers both and the warmup
         runtime prewarms whichever the engine was built with.
-      k_scale, v_scale: per-(block, slot, head) fp32 dequantization
-        scales ``[n_blocks, block_len, H_kv]`` — required iff the pools
-        are int8 (``serving.kv_pool`` ``kv_dtype="int8"`` layout). Both
-        spellings dequantize before the softmax statistics; the pallas
-        kernel does it block-by-block in VMEM.
+      k_scale, v_scale: per-(block, slot, head) dequantization scale
+        siblings ``[n_blocks, block_len, H_kv]`` — required iff the
+        pools are quantized (``serving.kv_pool`` ``kv_dtype="int8"``:
+        fp32 multipliers; ``"fp8"``/``"fp8_e5m2"``: int8 power-of-two
+        exponents, multiplier ``2**e`` via ``kv_pool.scale_factors``).
+        Both spellings dequantize before the softmax statistics; the
+        pallas kernel does it block-by-block in VMEM.
+      split_s: flash-decoding worker count for the pallas spelling's
+        chain sweep (``ops.paged_flash``): None auto-enables when W/B
+        crosses the split threshold, 1 forces the single-worker sweep,
+        S > 1 forces S workers. The dense spelling has no chain sweep
+        to split — it ignores this knob.
 
     Returns ``[B, C, H, D]`` in q's dtype. Softmax statistics in fp32.
     """
+    from pytorch_distributed_tpu.serving.kv_pool import (
+        is_quantized_pool,
+        scale_factors,
+    )
+
     if gather_impl not in ("dense", "pallas"):
         raise ValueError(
             f"gather_impl {gather_impl!r} must be 'dense' (jnp.take "
@@ -213,12 +226,12 @@ def paged_attention(
             "compilecache/registry.py for the bucket enumeration both "
             "stay in sync with"
         )
-    quantized = jnp.issubdtype(k_pool.dtype, jnp.integer)
+    quantized = is_quantized_pool(k_pool.dtype)
     if bool(quantized) != (k_scale is not None):
         raise ValueError(
-            "int8 pools need k_scale/v_scale and float pools must not "
-            f"pass them (pool dtype {k_pool.dtype}, k_scale "
-            f"{'set' if k_scale is not None else 'None'})"
+            "quantized (int8/fp8) pools need k_scale/v_scale and float "
+            f"pools must not pass them (pool dtype {k_pool.dtype}, "
+            f"k_scale {'set' if k_scale is not None else 'None'})"
         )
     if gather_impl == "pallas":
         from pytorch_distributed_tpu.ops.paged_flash import (
@@ -227,7 +240,7 @@ def paged_attention(
 
         return paged_flash_attention(
             q, k_pool, v_pool, block_tables, q_positions, scale=scale,
-            k_scale=k_scale, v_scale=v_scale,
+            k_scale=k_scale, v_scale=v_scale, split_s=split_s,
         )
     b, c, h, d = q.shape
     n_blocks, block_len, h_kv, _ = k_pool.shape
@@ -246,17 +259,17 @@ def paged_attention(
         b, w * block_len, h_kv, d
     )
     if k_scale is not None:
-        # int8 pool: dequantize AFTER the gather (per-row-per-head
-        # scales ride the same take), keeping the einsums below on fp32
-        # values identical to what the pallas kernel dequantizes in VMEM
-        ks = jnp.take(k_scale, block_tables, axis=0).reshape(
-            b, w * block_len, h_kv
-        )
-        vs = jnp.take(v_scale, block_tables, axis=0).reshape(
-            b, w * block_len, h_kv
-        )
-        kg = kg.astype(jnp.float32) * ks[..., None]  # jaxlint: disable=precision-cast -- int8 dequantization to the fp32 softmax-statistics dtype
-        vg = vg.astype(jnp.float32) * vs[..., None]  # jaxlint: disable=precision-cast -- int8 dequantization to the fp32 softmax-statistics dtype
+        # quantized pool: dequantize AFTER the gather (per-row-per-head
+        # scale siblings ride the same take; scale_factors turns int8
+        # exponents into 2**e multipliers for fp8 pools), keeping the
+        # einsums below on fp32 values identical to what the pallas
+        # kernel dequantizes in VMEM
+        ks = jnp.take(scale_factors(k_scale), block_tables,
+                      axis=0).reshape(b, w * block_len, h_kv)
+        vs = jnp.take(scale_factors(v_scale), block_tables,
+                      axis=0).reshape(b, w * block_len, h_kv)
+        kg = kg.astype(jnp.float32) * ks[..., None]  # jaxlint: disable=precision-cast -- quantized-pool dequantization to the fp32 softmax-statistics dtype
+        vg = vg.astype(jnp.float32) * vs[..., None]  # jaxlint: disable=precision-cast -- quantized-pool dequantization to the fp32 softmax-statistics dtype
     # Grouped logits directly against the narrow heads (query head
     # h = h_kv_idx*group + g), fp32 statistics like every other path.
     qg = (q.astype(jnp.float32) * scale).reshape(b, c, h_kv, group, d)  # jaxlint: disable=precision-cast -- fp32 softmax statistics by kernel contract

@@ -32,10 +32,27 @@ Structure (per the in-tree FlashAttention kernel,
   exactly like the dense spelling. Blocks entirely past the batch row's
   query frontier are skipped with ``pl.when`` (no FLOPs, no dequant);
 - softmax statistics in fp32 regardless of pool/compute dtype;
-- int8 pools dequantize INSIDE the kernel: per-(block, slot, head)
-  scales (``serving.kv_pool.quantize_kv``) ride the same index maps as
-  their pool, so the f32 K/V rows exist only in VMEM, block by block —
-  HBM holds int8 + scales (the ~2x pool-capacity win);
+- quantized pools (int8 or fp8) dequantize INSIDE the kernel: per-
+  (block, slot, head) scale siblings (``serving.kv_pool.quantize_kv``)
+  ride the same index maps as their pool, so the f32 K/V rows exist
+  only in VMEM, block by block — HBM holds 1-byte values + scales (the
+  2D/(D+4) int8 / 2D/(D+1) fp8 pool-capacity win). fp8 scale siblings
+  are int8 power-of-two exponents: the in-VMEM multiplier is ``2**e``
+  (exact), so the fp8 cast is the whole error budget;
+- flash-decoding (round 20; FlashAttention-2's work partitioning,
+  PAPERS.md §2, applied to decode): ``split_s`` > 1 splits the chain
+  sweep across S grid workers, each owning ``ceil(W/S)`` chain blocks
+  with its own (m, l, acc) VMEM partials, and a second-stage cross-
+  worker log-sum-exp merge (fp32, outside the kernel) combines them —
+  one long-context request (W large, B small) fills the chip instead
+  of serializing on the innermost grid axis. ``split_s=None``
+  auto-enables via ``auto_split_s`` when W/B crosses the threshold;
+  ``pl.when`` frontier skipping applies per worker unchanged;
+- the write side has a fused twin: ``paged_quantize_scatter`` computes
+  per-row-per-head scales and writes quantized rows + scale siblings
+  inside the scatter (``input_output_aliases`` keeps unvisited pool
+  blocks in place), sharing ``serving.kv_pool.quantize_rows`` with the
+  jnp spelling so the two are bit-equivalent by construction;
 - ``interpret=None`` auto-detects non-TPU backends and runs the Pallas
   interpreter, so CPU tier-1 executes the same call sites unmodified
   (the ``flash_attention`` convention).
@@ -66,15 +83,89 @@ _COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
 )
 
 
+#: flash-decoding auto policy (``split_s=None``): split when one batch
+#: row's chain is at least this many blocks per batch row — the shape
+#: where the W grid axis serializes a mostly-idle chip.
+SPLIT_THRESHOLD = 8
+#: auto policy's worker-count cap (forced ``split_s=`` may exceed it)
+MAX_SPLIT = 8
+
+
+def auto_split_s(w: int, b: int, *, threshold: int = SPLIT_THRESHOLD,
+                 max_split: int = MAX_SPLIT) -> int:
+    """Flash-decoding worker count for a ``[B, W]`` block table: 1 (no
+    split) until ``W / B >= threshold`` — few long chains is the shape
+    where the sequential chain sweep leaves grid workers idle — then
+    ``min(max_split, W)`` so every worker owns at least one block.
+    Static shapes in, static count out: the decision is compiled into
+    the program, and the registry fingerprint keys it via the config's
+    ``split_s`` field."""
+    if w // max(b, 1) < threshold:
+        return 1
+    return min(max_split, w)
+
+
+def _attend_block(q_ref, qpos, k_ref, v_ref, ks_ref, vs_ref,
+                  m_scr, l_scr, acc_scr, *, scale, k_start,
+                  quantized, fp8_scales):
+    """One chain block's online-softmax update — the shared inner body
+    of the single-worker and split-S kernels (one spelling, so the
+    split path cannot drift from the sweep it partitions)."""
+    # Fold the softmax scale into Q (one [R, D] multiply, the flash
+    # kernel's trick), fp32 logits on the MXU.
+    q = q_ref[0, 0]  # [R, D]
+    k = k_ref[0, :, 0, :]  # [block_len, D]
+    v = v_ref[0, :, 0, :]
+    if quantized:
+        # dequantize THIS block only, in VMEM: per-(slot, head) scale
+        # siblings gathered by the same table-driven index map. fp8
+        # pools carry int8 exponents — multiplier 2**e, exact in fp32
+        # (kv_pool.scale_factors spelling).
+        ks = ks_ref[0, :, 0]
+        vs = vs_ref[0, :, 0]
+        if fp8_scales:
+            ks = jnp.exp2(ks.astype(jnp.float32))
+            vs = jnp.exp2(vs.astype(jnp.float32))
+        k = k.astype(jnp.float32) * ks[:, None]
+        v = v.astype(jnp.float32) * vs[:, None]
+    s = jax.lax.dot_general(
+        q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [R, block_len]
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    # Frontier mask: key position j visible iff j <= the row's query
+    # position. Trash-table entries (unallocated tail) carry logical
+    # positions past every live frontier → fully masked, exactly the
+    # dense spelling's argument. Padding rows (qpos == -1) mask
+    # everything → l stays 0 → zeros out, sliced away by the caller.
+    mask = k_pos <= qpos[:, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    p = p * mask  # fully-masked rows stay all-zero (l == 0 → out 0)
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[:] = jnp.broadcast_to(
+        l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+        l_scr.shape,
+    )
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+
 def _paged_kernel(
     tables_ref,  # scalar-prefetch [B, W] int32 (SMEM)
     q_ref, qpos_ref, k_ref, v_ref,  # + (ks_ref, vs_ref) when quantized
     *refs,
-    scale: float, block_len: int, quantized: bool,
+    scale: float, block_len: int, quantized: bool, fp8_scales: bool,
 ):
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
     else:
+        ks_ref = vs_ref = None
         o_ref, m_scr, l_scr, acc_scr = refs
     j = pl.program_id(2)
     n_w = pl.num_programs(2)
@@ -89,42 +180,9 @@ def _paged_kernel(
     k_start = j * block_len
 
     def _block():
-        # Fold the softmax scale into Q (one [R, D] multiply, the flash
-        # kernel's trick), fp32 logits on the MXU.
-        q = q_ref[0, 0]  # [R, D]
-        k = k_ref[0, :, 0, :]  # [block_len, D]
-        v = v_ref[0, :, 0, :]
-        if quantized:
-            # dequantize THIS block only, in VMEM: per-(slot, head)
-            # scales gathered by the same table-driven index map
-            k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
-            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
-        s = jax.lax.dot_general(
-            q * jnp.asarray(scale, q.dtype), k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [R, block_len]
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        # Frontier mask: key position j visible iff j <= the row's query
-        # position. Trash-table entries (unallocated tail) carry logical
-        # positions past every live frontier → fully masked, exactly the
-        # dense spelling's argument. Padding rows (qpos == -1) mask
-        # everything → l stays 0 → zeros out, sliced away by the caller.
-        mask = k_pos <= qpos[:, None]
-        s = jnp.where(mask, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        p = p * mask  # fully-masked rows stay all-zero (l == 0 → out 0)
-        corr = jnp.exp(m_prev - m_new)
-        l_scr[:] = jnp.broadcast_to(
-            l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
-            l_scr.shape,
-        )
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        _attend_block(q_ref, qpos, k_ref, v_ref, ks_ref, vs_ref,
+                      m_scr, l_scr, acc_scr, scale=scale, k_start=k_start,
+                      quantized=quantized, fp8_scales=fp8_scales)
 
     # A chain block entirely past this batch row's query frontier
     # contributes nothing — skip its FLOPs (and its dequant) entirely.
@@ -134,6 +192,51 @@ def _paged_kernel(
     def _finalize():
         l = jnp.maximum(l_scr[:, :1], 1e-37)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _paged_split_kernel(
+    tables_ref,  # scalar-prefetch [B, W] int32 (SMEM)
+    q_ref, qpos_ref, k_ref, v_ref,  # + (ks_ref, vs_ref) when quantized
+    *refs,
+    scale: float, block_len: int, quantized: bool, fp8_scales: bool,
+    w: int, wc: int,
+):
+    """Flash-decoding worker kernel: grid ``(B, H_kv, S, ceil(W/S))``,
+    worker s sweeps chain blocks ``[s*wc, min((s+1)*wc, W))`` with its
+    own (m, l, acc) partials and emits them UN-normalized — the caller's
+    fp32 log-sum-exp merge combines workers. Same ``_attend_block``
+    inner body as the single-worker sweep, same ``pl.when`` frontier
+    skip per worker (plus the ceil-split tail guard ``j < W``: past-end
+    grid steps clamp their index map to a real block and skip)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+    jj = pl.program_id(3)
+
+    @pl.when(jj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qpos = qpos_ref[0]  # [R] per-row absolute query positions (pad = -1)
+    j = pl.program_id(2) * wc + jj  # logical chain index of this step
+    k_start = j * block_len
+
+    def _block():
+        _attend_block(q_ref, qpos, k_ref, v_ref, ks_ref, vs_ref,
+                      m_scr, l_scr, acc_scr, scale=scale, k_start=k_start,
+                      quantized=quantized, fp8_scales=fp8_scales)
+
+    pl.when((j < w) & (k_start <= jnp.max(qpos)))(_block)
+
+    @pl.when(jj == wc - 1)
+    def _finalize():
+        o_ref[0, 0, 0] = acc_scr[:]
+        m_ref[0, 0, 0] = m_scr[:]
+        l_ref[0, 0, 0] = l_scr[:]
 
 
 def paged_flash_attention(
@@ -146,6 +249,7 @@ def paged_flash_attention(
     scale: Optional[float] = None,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    split_s: Optional[int] = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused block-gather attention: decode/chunk queries against a
@@ -162,27 +266,42 @@ def paged_flash_attention(
         ``block_tables[b, w]``.
       q_positions: ``[B, C]`` int32 absolute positions; key position j
         is visible to query i iff ``j <= q_positions[i]``.
-      k_scale, v_scale: ``[n_blocks, block_len, H_kv]`` fp32
-        dequantization scales for int8 pools
-        (``serving.kv_pool.quantize_kv`` layout); None for float pools.
+      k_scale, v_scale: ``[n_blocks, block_len, H_kv]`` scale siblings
+        for quantized pools (``serving.kv_pool.quantize_kv`` layout:
+        fp32 multipliers for int8 pools, int8 power-of-two exponents
+        for fp8 pools); None for float pools.
+      split_s: flash-decoding worker count for the chain sweep. None
+        auto-enables (``auto_split_s``: split when W/B crosses the
+        threshold), 1 forces the single-worker sweep, S > 1 splits the
+        chain over S workers with un-normalized (m, l, acc) partials
+        and a second-stage fp32 log-sum-exp merge. The combine is a
+        different (but fp32) reduction order than the single sweep, so
+        parity is bounded (≤ 1e-3 on fp32 logits), not bit-equal.
       interpret: force the Pallas interpreter; None auto-detects
         (interpreter on any non-TPU backend, like ``flash_attention``).
 
     Returns ``[B, C, H, D]`` in q's dtype; softmax statistics fp32.
     """
+    from pytorch_distributed_tpu.serving.kv_pool import is_quantized_pool
+
     b, c, h, d = q.shape
     n_blocks, block_len, h_kv, _ = k_pool.shape
     if h % h_kv:
         raise ValueError(
             f"query heads {h} not a multiple of pool KV heads {h_kv}"
         )
-    quantized = jnp.issubdtype(k_pool.dtype, jnp.integer)
+    quantized = is_quantized_pool(k_pool.dtype)
     if quantized != (k_scale is not None):
         raise ValueError(
-            "int8 pools need k_scale/v_scale and float pools must not "
-            f"pass them (pool {k_pool.dtype}, k_scale "
+            "quantized (int8/fp8) pools need k_scale/v_scale and float "
+            f"pools must not pass them (pool {k_pool.dtype}, k_scale "
             f"{'set' if k_scale is not None else 'None'})"
         )
+    # fp8 pools carry int8 EXPONENT scale siblings (dequant 2**e); int8
+    # pools carry fp32 multipliers — the scale dtype picks the spelling
+    fp8_scales = bool(
+        k_scale is not None and k_scale.dtype == jnp.dtype(jnp.int8)
+    )
     if interpret is None:
         # Mosaic compiles only on TPU; every other backend runs the
         # interpreter so CPU tier-1 executes this exact call site.
@@ -190,6 +309,10 @@ def paged_flash_attention(
     group = h // h_kv
     w = block_tables.shape[1]
     scale = scale if scale is not None else d ** -0.5
+    if split_s is not None and split_s < 1:
+        raise ValueError(f"split_s must be >= 1, got {split_s}")
+    s_workers = split_s if split_s is not None else auto_split_s(w, b)
+    s_workers = min(s_workers, w)  # every worker owns >= 1 chain block
 
     # GQA fold: query head h = kv·group + g reads narrow head kv, so the
     # per-narrow-head row block is its whole query group × chunk. Rows
@@ -206,55 +329,249 @@ def paged_flash_attention(
         q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, r_pad - r), (0, 0)))
         qpos = jnp.pad(qpos, ((0, 0), (0, r_pad - r)), constant_values=-1)
 
+    out_dtype = q.dtype
+    scratch_shapes = [
+        pltpu.VMEM((r_pad, 128), jnp.float32),  # running row max m
+        pltpu.VMEM((r_pad, 128), jnp.float32),  # running row sum l
+        pltpu.VMEM((r_pad, d), jnp.float32),  # un-normalized output
+    ]
+    kern_kw = dict(scale=scale, block_len=block_len,
+                   quantized=bool(quantized), fp8_scales=fp8_scales)
+
+    if s_workers == 1:
+        in_specs = [
+            pl.BlockSpec((1, 1, r_pad, d), lambda b, h, j, t: (b, h, 0, 0)),
+            pl.BlockSpec((1, r_pad), lambda b, h, j, t: (b, 0)),
+            # the fused gather: the block table entry IS the index map —
+            # the pipeline DMAs pool block tables[b, j] (this narrow
+            # head's slice) straight into VMEM, no gathered copy in HBM
+            pl.BlockSpec((1, block_len, 1, d),
+                         lambda b, h, j, t: (t[b, j], 0, h, 0)),
+            pl.BlockSpec((1, block_len, 1, d),
+                         lambda b, h, j, t: (t[b, j], 0, h, 0)),
+        ]
+        operands = [q4, qpos, k_pool, v_pool]
+        if quantized:
+            in_specs += [
+                pl.BlockSpec((1, block_len, 1),
+                             lambda b, h, j, t: (t[b, j], 0, h)),
+                pl.BlockSpec((1, block_len, 1),
+                             lambda b, h, j, t: (t[b, j], 0, h)),
+            ]
+            operands += [k_scale, v_scale]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h_kv, w),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, r_pad, d),
+                                   lambda b, h, j, t: (b, h, 0, 0)),
+            scratch_shapes=scratch_shapes,
+        )
+        kwargs = {}
+        if not interpret:
+            kwargs["compiler_params"] = _COMPILER_PARAMS(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        out4 = pl.pallas_call(
+            functools.partial(_paged_kernel, **kern_kw),
+            out_shape=jax.ShapeDtypeStruct((b, h_kv, r_pad, d), out_dtype),
+            grid_spec=grid_spec,
+            interpret=interpret,
+            **kwargs,
+        )(block_tables.astype(jnp.int32), *operands)
+        out4 = out4[:, :, :r]  # drop row padding
+        return jnp.moveaxis(
+            out4.reshape(b, h_kv, group, c, d), 3, 1
+        ).reshape(b, c, h, d)
+
+    # ---- flash-decoding split: S workers over the chain, LSE merge ----
+    wc = -(-w // s_workers)  # chain blocks per worker (ceil split)
+
+    def _kj(s, jj):
+        # ceil-split tail: grid steps past the real chain clamp to the
+        # last block — the kernel's ``j < w`` guard skips them, so the
+        # clamped DMA target is never read into the statistics
+        return jnp.minimum(s * wc + jj, w - 1)
+
     in_specs = [
-        pl.BlockSpec((1, 1, r_pad, d), lambda b, h, j, t: (b, h, 0, 0)),
-        pl.BlockSpec((1, r_pad), lambda b, h, j, t: (b, 0)),
-        # the fused gather: the block table entry IS the index map — the
-        # pipeline DMAs pool block tables[b, j] (this narrow head's
-        # slice) straight into VMEM, no gathered copy in HBM
+        pl.BlockSpec((1, 1, r_pad, d), lambda b, h, s, j, t: (b, h, 0, 0)),
+        pl.BlockSpec((1, r_pad), lambda b, h, s, j, t: (b, 0)),
         pl.BlockSpec((1, block_len, 1, d),
-                     lambda b, h, j, t: (t[b, j], 0, h, 0)),
+                     lambda b, h, s, j, t: (t[b, _kj(s, j)], 0, h, 0)),
         pl.BlockSpec((1, block_len, 1, d),
-                     lambda b, h, j, t: (t[b, j], 0, h, 0)),
+                     lambda b, h, s, j, t: (t[b, _kj(s, j)], 0, h, 0)),
     ]
     operands = [q4, qpos, k_pool, v_pool]
     if quantized:
         in_specs += [
             pl.BlockSpec((1, block_len, 1),
-                         lambda b, h, j, t: (t[b, j], 0, h)),
+                         lambda b, h, s, j, t: (t[b, _kj(s, j)], 0, h)),
             pl.BlockSpec((1, block_len, 1),
-                         lambda b, h, j, t: (t[b, j], 0, h)),
+                         lambda b, h, s, j, t: (t[b, _kj(s, j)], 0, h)),
         ]
         operands += [k_scale, v_scale]
-    out_dtype = q.dtype
+    part_spec = pl.BlockSpec((1, 1, 1, r_pad, d),
+                             lambda b, h, s, j, t: (b, h, s, 0, 0))
+    stat_spec = pl.BlockSpec((1, 1, 1, r_pad, 128),
+                             lambda b, h, s, j, t: (b, h, s, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, h_kv, w),
+        grid=(b, h_kv, s_workers, wc),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, r_pad, d),
-                               lambda b, h, j, t: (b, h, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((r_pad, 128), jnp.float32),  # running row max m
-            pltpu.VMEM((r_pad, 128), jnp.float32),  # running row sum l
-            pltpu.VMEM((r_pad, d), jnp.float32),  # un-normalized output
-        ],
+        out_specs=[part_spec, stat_spec, stat_spec],
+        scratch_shapes=scratch_shapes,
     )
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = _COMPILER_PARAMS(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")
         )
-    out4 = pl.pallas_call(
-        functools.partial(
-            _paged_kernel, scale=scale, block_len=block_len,
-            quantized=bool(quantized),
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, h_kv, r_pad, d), out_dtype),
+    acc_p, m_p, l_p = pl.pallas_call(
+        functools.partial(_paged_split_kernel, w=w, wc=wc, **kern_kw),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h_kv, s_workers, r_pad, d),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((b, h_kv, s_workers, r_pad, 128),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((b, h_kv, s_workers, r_pad, 128),
+                                 jnp.float32),
+        ],
         grid_spec=grid_spec,
         interpret=interpret,
         **kwargs,
     )(block_tables.astype(jnp.int32), *operands)
+    # Second stage: cross-worker log-sum-exp merge, fp32. A worker whose
+    # every block was masked/skipped holds (m=NEG_INF, l=0, acc=0):
+    # NEG_INF is finite, so exp(m - m_star) is exp(0)=1 at worst and its
+    # zero l/acc contribute nothing — all-masked rows (padding) keep the
+    # single-sweep convention l=0 → out 0 via the epsilon.
+    m_w = m_p[..., 0]  # [B, H_kv, S, R] (broadcast columns, take one)
+    l_w = l_p[..., 0]
+    m_star = jnp.max(m_w, axis=2)
+    alpha = jnp.exp(m_w - m_star[:, :, None])  # [B, H_kv, S, R]
+    l_tot = jnp.sum(l_w * alpha, axis=2)  # [B, H_kv, R]
+    acc = jnp.sum(acc_p * alpha[..., None], axis=2)  # [B, H_kv, R, D]
+    out4 = (acc / jnp.maximum(l_tot, 1e-37)[..., None]).astype(out_dtype)
     out4 = out4[:, :, :r]  # drop row padding
     return jnp.moveaxis(
         out4.reshape(b, h_kv, group, c, d), 3, 1
     ).reshape(b, c, h, d)
+
+
+def paged_quantize_scatter(
+    k: jax.Array,
+    v: jax.Array,
+    blk: jax.Array,
+    off: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    *,
+    interpret: bool | None = None,
+):
+    """Fused quantize-on-scatter: write a chunk's KV rows into a
+    quantized pool, computing each row's per-head scale and casting to
+    the pool dtype INSIDE the scatter — the write-side twin of the
+    fused gather above. The jnp spelling (``serving.kv_pool.
+    quantize_kv`` + four ``.at[rows].set``) stays the dense/interpret
+    reference; both call ``kv_pool.quantize_rows`` for the row math, so
+    the two spellings produce bit-identical pools and greedy streams
+    cannot diverge across the scatter implementation.
+
+    Grid ``(B·L,)``: one step per written row. The (block, offset)
+    destination pair rides in as a scalar-prefetch operand and the pool
+    OUTPUT BlockSpec index map resolves it — the scatter analogue of the
+    gather's table-driven index map. ``input_output_aliases`` pins each
+    pool/scale output to its input buffer, so the write is in place and
+    unvisited blocks keep their rows (required for correctness, not
+    just speed — the pools are donated engine state). Duplicate
+    destinations exist only for trash-block writes (inactive lanes),
+    where any write order is harmless garbage.
+
+    Args:
+      k, v: ``[B, L, H_kv, D]`` rows to write (post-RoPE, compute
+        dtype).
+      blk, off: ``[B, L]`` int32 destination block ids / in-block
+        offsets (``models.transformer.Attention`` derives them from the
+        block table and ``position_offset``).
+      k_pool, v_pool: ``[n_blocks, block_len, H_kv, D]`` quantized
+        pools (int8 or fp8).
+      k_scale, v_scale: ``[n_blocks, block_len, H_kv]`` scale siblings
+        (fp32 multipliers for int8, int8 exponents for fp8 —
+        ``kv_pool.pool_scale_dtype``).
+      interpret: force the Pallas interpreter; None auto-detects.
+
+    Returns the updated ``(k_pool, v_pool, k_scale, v_scale)``.
+    """
+    from pytorch_distributed_tpu.serving.kv_pool import (
+        is_quantized_pool,
+        quantize_rows,
+    )
+
+    if not is_quantized_pool(k_pool.dtype):
+        raise ValueError(
+            "paged_quantize_scatter writes quantized pools (int8/fp8); "
+            f"got pool dtype {k_pool.dtype} — raw pools scatter with a "
+            "plain .at[].set, there is nothing to fuse"
+        )
+    b, l, h_kv, d = k.shape
+    n = b * l
+    pool_dt = k_pool.dtype
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # one [2, N] scalar-prefetch operand: row i writes pool block
+    # idx[0, i] at in-block offset idx[1, i]
+    idx = jnp.stack(
+        [blk.reshape(-1), off.reshape(-1)]
+    ).astype(jnp.int32)
+    kf = k.reshape(n, h_kv, d)
+    vf = v.reshape(n, h_kv, d)
+
+    def _kernel(idx_ref, k_ref, v_ref, kp_in, vp_in, ks_in, vs_in,
+                kp_out, vp_out, ks_out, vs_out):
+        del idx_ref, kp_in, vp_in, ks_in, vs_in  # aliased with outputs
+        qk, sk = quantize_rows(k_ref[0].astype(jnp.float32), pool_dt)
+        qv, sv = quantize_rows(v_ref[0].astype(jnp.float32), pool_dt)
+        kp_out[0, 0] = qk
+        vp_out[0, 0] = qv
+        ks_out[0, 0] = sk
+        vs_out[0, 0] = sv
+
+    row_spec = pl.BlockSpec((1, h_kv, d), lambda i, idx: (i, 0, 0))
+    pool_spec = pl.BlockSpec(
+        (1, 1, h_kv, d), lambda i, idx: (idx[0, i], idx[1, i], 0, 0)
+    )
+    sc_spec = pl.BlockSpec(
+        (1, 1, h_kv), lambda i, idx: (idx[0, i], idx[1, i], 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[row_spec, row_spec,
+                  pool_spec, pool_spec, sc_spec, sc_spec],
+        out_specs=[pool_spec, pool_spec, sc_spec, sc_spec],
+    )
+    kwargs = {}
+    if not interpret:
+        # trash-block duplicates make write order observable in garbage
+        # only; still, "arbitrary" keeps the sweep sequential
+        kwargs["compiler_params"] = _COMPILER_PARAMS(
+            dimension_semantics=("arbitrary",)
+        )
+    return pl.pallas_call(
+        _kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ],
+        grid_spec=grid_spec,
+        # operand index space includes the scalar-prefetch arg: 0=idx,
+        # 1=k rows, 2=v rows, 3..6=the four pools -> outputs 0..3
+        input_output_aliases={3: 0, 4: 1, 5: 2, 6: 3},
+        interpret=interpret,
+        **kwargs,
+    )(idx, kf, vf, k_pool, v_pool, k_scale, v_scale)

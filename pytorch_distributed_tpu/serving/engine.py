@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -150,7 +151,9 @@ class PagedEngine:
                  handoff: bool = False, swap: bool = False,
                  gather_impl: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 split_s: Optional[int] = None,
+                 autotune_dir: Optional[str] = None):
         from pytorch_distributed_tpu.models.generate import (
             _validate_sampling,
             _validate_serving_config,
@@ -174,6 +177,45 @@ class PagedEngine:
                 f"kv_dtype {kv_dtype!r} must be one of {KV_DTYPES}"
             )
         self.kv_dtype = kv_dtype
+        # Autotuned kernel config (telemetry/autotune.py): if a tuned
+        # file exists for this engine's autotune fingerprint — the
+        # registry fingerprint with the TUNED knobs (block_len /
+        # prefill_chunk / split_s) normalized out, so the key never
+        # depends on the values being tuned — load it and let it
+        # override the defaults. Explicit caller arguments win over the
+        # tuned file (you asked for that value, you get it); a missing,
+        # stale, or corrupt tuned file is a clean miss, never an error.
+        self.autotune_dir = (
+            autotune_dir if autotune_dir is not None
+            else os.environ.get("PDT_AUTOTUNE_DIR") or None
+        )
+        self.tuned = None
+        self._tuned_key = None
+        if self.autotune_dir:
+            from pytorch_distributed_tpu.telemetry.autotune import (
+                autotune_fingerprint,
+                load_tuned,
+            )
+
+            self._tuned_key = autotune_fingerprint(
+                config, n_slots, kv_dtype=kv_dtype,
+                temperature=temperature, top_k=top_k,
+                prefix_cache=prefix_cache, mesh=mesh,
+            )
+            self.tuned = load_tuned(self.autotune_dir, self._tuned_key)
+            if self.tuned is not None:
+                if block_len == 16:  # signature default → tunable
+                    block_len = self.tuned.block_len
+                if prefill_chunk == 128:  # signature default → tunable
+                    prefill_chunk = self.tuned.prefill_chunk
+                if split_s is None:
+                    split_s = self.tuned.split_s
+        # The split-S knob lives on the config (like gather_impl) so the
+        # model, the registry fingerprint, and this engine agree on one
+        # value — programs compiled with different splits never share a
+        # cache entry.
+        if split_s is not None and split_s != config.split_s:
+            config = dataclasses.replace(config, split_s=split_s)
         if mesh is not None and device is not None:
             raise ValueError(
                 "pass mesh= (TP sub-mesh) or device= (single-device "
@@ -289,6 +331,27 @@ class PagedEngine:
         """The KV gather spelling the engine's programs compile with
         (lives on the config so model, fingerprint, and engine agree)."""
         return self.config.gather_impl
+
+    def tuned_provenance(self) -> Dict[str, object]:
+        """Which kernel config actually served: tuned or default.
+
+        Telemetry cost cards carry these keys so forensics
+        (``explain_request`` / ``telemetry_report``) can tell whether a
+        program ran with an autotuned config and whether that config's
+        fingerprint still matches this engine (staleness is a clean
+        miss at load time, so ``tuned_match`` is True whenever a tuned
+        config applied at all).
+        """
+        out: Dict[str, object] = {
+            "tuned": self.tuned is not None,
+            "tuned_block_len": self.block_len,
+            "tuned_prefill_chunk": self.chunk,
+            "tuned_split_s": self.config.split_s,
+        }
+        if self._tuned_key is not None:
+            out["tuned_fingerprint"] = self._tuned_key
+            out["tuned_match"] = self.tuned is not None
+        return out
 
     # ---- program builders (cached per static shape) ----
 

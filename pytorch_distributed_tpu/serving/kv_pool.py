@@ -73,26 +73,102 @@ SWAP_STATES = (SWAPPING_OUT, SWAPPING_IN)
 #: pool dtypes ``init_paged_cache`` accepts: None keeps the model compute
 #: dtype (the raw layout); "int8" stores quantized K/V plus per-
 #: (block, slot, head) fp32 scales — ~2x the blocks at fixed pool bytes
-#: (exactly 2D/(D+4) with fp32 scales; ANALYSIS.md "Paged attention
-#: kernel & quantized KV").
-KV_DTYPES = (None, "int8")
+#: (exactly 2D/(D+4) with fp32 scales); "fp8" (e4m3) / "fp8_e5m2" store
+#: fp8 K/V plus per-row int8 power-of-two EXPONENT siblings — 2D/(D+1),
+#: 1.97x at the GPT-2 head dim (ANALYSIS.md "Kernel tier 2").
+KV_DTYPES = (None, "int8", "fp8", "fp8_e5m2")
+
+#: fp8 storage dtypes by KV_DTYPES name. e4m3 ("fp8") is the default
+#: recommendation: 3 mantissa bits halve the rounding error of e5m2's 2,
+#: and the per-row exponent sibling supplies all the dynamic range e5m2
+#: would otherwise buy.
+FP8_DTYPES = {"fp8": jnp.float8_e4m3fn, "fp8_e5m2": jnp.float8_e5m2}
 
 
-def quantize_kv(x: jax.Array):
-    """Symmetric per-(token, head) int8 quantization of a K or V chunk.
+def kv_pool_dtype(kv_dtype: str):
+    """Storage jnp dtype for a non-None ``KV_DTYPES`` name."""
+    if kv_dtype == "int8":
+        return jnp.int8
+    if kv_dtype in FP8_DTYPES:
+        return FP8_DTYPES[kv_dtype]
+    raise ValueError(
+        f"kv_dtype {kv_dtype!r} must be one of {KV_DTYPES} (None "
+        "keeps the model compute dtype)"
+    )
 
-    ``x`` is ``[..., H_kv, D]``; returns ``(q int8 same shape, scales
-    fp32 [..., H_kv])`` with ``q = round(x / scale)`` and
-    ``scale = amax(|x|, D) / 127`` — one scale per written KV row, the
-    granularity the paged scatter writes at (a per-BLOCK scalar cannot
-    be maintained under incremental chunk/decode writes without
-    requantizing the block's resident rows). Dequantization is
-    ``q * scale`` (``ops.paged_flash`` does it in VMEM; the dense gather
-    right after the take)."""
+
+def is_quantized_pool(dtype) -> bool:
+    """True iff ``dtype`` is a quantized pool storage dtype (int8 or
+    fp8), i.e. the cache tree carries ``key_scale``/``value_scale``
+    siblings and the attention read path must dequantize. The pool
+    dtype IS the contract — no config flag to drift from it."""
+    dt = jnp.dtype(dtype)
+    return dt in (jnp.dtype(jnp.int8), jnp.dtype(jnp.float8_e4m3fn),
+                  jnp.dtype(jnp.float8_e5m2))
+
+
+def pool_scale_dtype(pool_dtype):
+    """Scale-sibling dtype for a quantized pool dtype: fp32 multipliers
+    for int8 pools (the PR 10 layout), int8 power-of-two exponents for
+    fp8 pools — 1 byte per row per head, which is where the fp8 layout's
+    2D/(D+1) capacity (vs int8's 2D/(D+4)) comes from."""
+    return (jnp.float32 if jnp.dtype(pool_dtype) == jnp.dtype(jnp.int8)
+            else jnp.int8)
+
+
+def scale_factors(scales: jax.Array) -> jax.Array:
+    """fp32 dequantization multipliers from a scale sibling. int8 scale
+    siblings (fp8 pools) hold power-of-two EXPONENTS: the multiplier is
+    ``2**e`` — exact in fp32, so the scale multiply itself contributes
+    zero rounding error and the fp8 cast is the whole error budget.
+    fp32 siblings (int8 pools) are the multiplier already."""
+    if scales.dtype == jnp.dtype(jnp.int8):
+        return jnp.exp2(scales.astype(jnp.float32))  # jaxlint: disable=precision-cast -- int8 exponents widen to the fp32 dequant-multiplier dtype
+    return scales
+
+
+def quantize_rows(xf: jax.Array, pool_dtype):
+    """Row-wise quantization math shared by the jnp spelling
+    (``quantize_kv``) and the Pallas quantize-on-scatter kernel
+    (``ops.paged_flash.paged_quantize_scatter``) — ONE function, so the
+    two spellings are bit-equivalent by construction.
+
+    ``xf`` is fp32 ``[..., H_kv, D]``. int8: symmetric, scale =
+    amax/127, fp32 scales. fp8: per-row power-of-two exponent
+    ``e = ceil(log2(amax / fmax))`` (row amax maps into the top octave
+    of the format's range), values stored as ``x * 2**-e`` cast to fp8,
+    exponents as int8. Returns ``(q, scales)``."""
+    pool_dtype = jnp.dtype(pool_dtype)
+    amax = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8)
+    if pool_dtype == jnp.dtype(jnp.int8):
+        # Spelled as a reciprocal MULTIPLY, not amax/127: XLA rewrites
+        # constant divisions to reciprocal multiplies under jit, so the
+        # divide spelling produces 1-ulp-different scales between an
+        # eager caller and the jitted Pallas scatter — the multiply is
+        # the same op in both, keeping the spellings bit-equivalent.
+        scales = amax * jnp.float32(1.0 / 127.0)
+        q = jnp.clip(jnp.round(xf / scales[..., None]), -127, 127)
+        return q.astype(jnp.int8), scales
+    fmax = float(jnp.finfo(pool_dtype).max)
+    e = jnp.clip(jnp.ceil(jnp.log2(amax / fmax)), -126.0, 126.0)
+    q = (xf * jnp.exp2(-e)[..., None]).astype(pool_dtype)
+    return q, e.astype(jnp.int8)
+
+
+def quantize_kv(x: jax.Array, pool_dtype=jnp.int8):
+    """Per-(token, head) quantization of a K or V chunk to a pool
+    storage dtype (int8 default — the PR 10 signature; fp8 via
+    ``pool_dtype=jnp.float8_e4m3fn``/``e5m2``).
+
+    ``x`` is ``[..., H_kv, D]``; returns ``(q same shape, scales
+    [..., H_kv])`` in the ``quantize_rows`` layout — one scale per
+    written KV row, the granularity the paged scatter writes at (a
+    per-BLOCK scalar cannot be maintained under incremental chunk/
+    decode writes without requantizing the block's resident rows).
+    Dequantization is ``q * scale_factors(scales)`` (``ops.paged_flash``
+    does it in VMEM; the dense gather right after the take)."""
     xf = x.astype(jnp.float32)  # jaxlint: disable=precision-cast -- fp32 quantization statistics regardless of compute dtype
-    scales = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
-    q = jnp.clip(jnp.round(xf / scales[..., None]), -127, 127)
-    return q.astype(jnp.int8), scales
+    return quantize_rows(xf, pool_dtype)
 
 
 def blocks_needed(prompt_len: int, max_new_tokens: int, block_len: int,
@@ -356,7 +432,10 @@ def init_paged_cache(config, params, n_blocks: int, block_len: int,
     ``value`` leaf becomes int8 and gains a ``key_scale``/``value_scale``
     sibling ``[n_blocks, block_len, H_kv]`` fp32 (the ``quantize_kv``
     layout — one scale per written row per head, so quantize-on-scatter
-    and TP head-sharding both work unchanged). The attention read path
+    and TP head-sharding both work unchanged). ``"fp8"`` (e4m3) /
+    ``"fp8_e5m2"`` are the same layout at 1-byte values with 1-byte
+    int8 EXPONENT siblings (``pool_scale_dtype``) — 2D/(D+1) capacity
+    vs bf16 where int8+fp32 scales is 2D/(D+4). The attention read path
     dequantizes (in-VMEM for ``gather_impl="pallas"``, post-take for
     "dense"); ``models.transformer.Attention`` switches to quantize-on-
     scatter off the pool dtype alone, so the cache pytree IS the whole
@@ -383,26 +462,30 @@ def init_paged_cache(config, params, n_blocks: int, block_len: int,
 
     from collections.abc import Mapping
 
+    pool_dt = kv_pool_dtype(kv_dtype)
+    sc_dt = pool_scale_dtype(pool_dt)
+
     def _quantized(node):
         # each layer's attention cache is a {"key": [1, L, H_kv, D],
-        # "value": ...} pair; replace it with int8 pools + scale siblings
+        # "value": ...} pair; replace it with quantized pools + scale
+        # siblings (fp32 multipliers for int8, int8 exponents for fp8)
         if isinstance(node, Mapping) and set(node) == {"key", "value"}:
             out = {}
             for name in ("key", "value"):
                 s = node[name]
                 out[name] = jnp.zeros(
-                    (n_blocks, block_len) + s.shape[2:], jnp.int8
+                    (n_blocks, block_len) + s.shape[2:], pool_dt
                 )
                 out[name + "_scale"] = jnp.zeros(
-                    (n_blocks, block_len, s.shape[2]), jnp.float32
+                    (n_blocks, block_len, s.shape[2]), sc_dt
                 )
             return out
         if isinstance(node, Mapping):
             return {k: _quantized(node[k]) for k in node}
         raise ValueError(
-            "unexpected cache tree layout for kv_dtype='int8': expected "
-            "nested dicts ending in {'key', 'value'} leaf pairs, got "
-            f"{type(node).__name__}"
+            f"unexpected cache tree layout for kv_dtype={kv_dtype!r}: "
+            "expected nested dicts ending in {'key', 'value'} leaf "
+            f"pairs, got {type(node).__name__}"
         )
 
     return _quantized(shapes)
@@ -430,7 +513,8 @@ def paged_cache_specs(config, cache):
     as the dense cache) shards over the model axis, exactly the slice
     each shard's Attention computes. Reuses the dense serving rule
     (``models.generate._cache_specs``) so the two layouts cannot drift.
-    An int8 pool's rank-3 scale leaves ``[n_blocks, block_len, H_kv]``
+    A quantized pool's (int8 or fp8) rank-3 scale leaves
+    ``[n_blocks, block_len, H_kv]``
     shard the same head dim (now the LAST axis): their spec is the
     rank-4 rule with its trailing D entry dropped — derived, so it
     cannot drift either."""

@@ -260,7 +260,9 @@ class Scheduler:
                  host_store=None,
                  host_store_max_bytes: Optional[int] = None,
                  reqtrace=None, ledger=None, host_pool=None,
-                 prefix_cache: bool = False, blocksan=None):
+                 prefix_cache: bool = False, blocksan=None,
+                 split_s: Optional[int] = None,
+                 autotune_dir: Optional[str] = None):
         from pytorch_distributed_tpu.serving.engine import PagedEngine
         from pytorch_distributed_tpu.serving.kv_pool import HostBlockStore
 
@@ -285,7 +287,8 @@ class Scheduler:
             top_k=top_k, mesh=mesh, device=device,
             handoff=(handoff or prefill_only), swap=offload,
             gather_impl=gather_impl, kv_dtype=kv_dtype,
-            prefix_cache=prefix_cache,
+            prefix_cache=prefix_cache, split_s=split_s,
+            autotune_dir=autotune_dir,
         )
         # ---- prefix-sharing tier (round 17): radix reuse + COW ----
         self.prefix_cache = prefix_cache
@@ -1904,7 +1907,8 @@ class Scheduler:
         from pytorch_distributed_tpu.telemetry import log_cost_cards
 
         return log_cost_cards(
-            serving_registry(self.engine), self.prog_times, self.metrics_log
+            serving_registry(self.engine), self.prog_times,
+            self.metrics_log, annotate=self.engine.tuned_provenance(),
         )
 
     # ---- metrics ----

@@ -1,0 +1,286 @@
+"""Cost-card-keyed kernel autotuner: sweep, persist, reload by fingerprint.
+
+Round 10 gave the serving stack two spellings of every KV-bound program
+and round 20 multiplied the variant space again (pool dtype × block_len
+× split-S × chunk bucket). Which point is fastest depends on the
+backend, the device generation, and the model shape — exactly the things
+``compilecache.run_fingerprint`` already encodes. This module closes the
+loop the ISSUE names "the measurement loop":
+
+- ``sweep`` times candidate ``(block_len, prefill_chunk, split_s)``
+  configs with the same warm-decode-tick methodology as
+  ``scripts/bench_serving.py --gather-ab`` (one untimed tick, then timed
+  ticks on a warm program), joins each candidate with its decode
+  program's cost-card roofline class (``costmodel.CostCard`` — so the
+  tuned file records WHY the winner won, not just that it did), and
+  picks the highest decode tok/s.
+- ``save_tuned``/``load_tuned`` persist the winner as JSON keyed by
+  ``autotune_fingerprint`` — the registry fingerprint with the TUNED
+  knobs normalized out (``split_s=None``, no block_len/chunk extras).
+  The tuned parameters must never appear in their own key: an engine
+  about to choose block_len cannot know it yet.
+- Staleness is structural: a tuned file whose recorded fingerprint does
+  not match the requesting engine's key simply does not load (clean
+  miss, never a crash, never a wrong config) — same contract as the
+  AOT artifact cache.
+
+``serving.engine.PagedEngine`` calls ``load_tuned`` at construction when
+``autotune_dir=`` (or env ``PDT_AUTOTUNE_DIR``) is set; explicit caller
+arguments always win over the tuned file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: bump when the tuned-file schema or sweep methodology changes — rides
+#: into the fingerprint so old files miss cleanly instead of misloading
+AUTOTUNE_VERSION = "autotune=v1"
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedConfig:
+    """One sweep's winner plus the evidence that picked it."""
+
+    block_len: int
+    prefill_chunk: int
+    split_s: Optional[int]
+    #: the autotune fingerprint this config is valid for (load key)
+    fingerprint: str
+    #: backend the sweep MEASURED on — a CPU-interpret sweep is a
+    #: plumbing exercise, not a TPU performance claim (honesty rule)
+    backend: str
+    decode_tok_s: float
+    #: roofline class of the winning decode program ("compute" /
+    #: "bandwidth" / None when ceilings are unknown)
+    decode_bound: Optional[str] = None
+    #: every candidate's row (knobs, tok/s, bound) for audit
+    candidates: Tuple[Dict, ...] = ()
+
+
+def autotune_fingerprint(config, n_slots: int, *, kv_dtype=None,
+                         temperature: float = 0.0, top_k=None,
+                         prefix_cache: bool = False, mesh=None) -> str:
+    """The tuned-file key: ``run_fingerprint`` over everything that
+    shapes the decode program EXCEPT the knobs being tuned.
+
+    ``split_s`` is normalized to None in the config repr and block_len /
+    prefill_chunk are deliberately absent from the extras (contrast
+    ``serving_registry``, which includes all three — program artifacts
+    must not cross tuned variants, but the tuned file must be findable
+    BEFORE the variant is chosen)."""
+    from pytorch_distributed_tpu.compilecache.registry import (
+        run_fingerprint,
+    )
+
+    norm = dataclasses.replace(config, split_s=None)
+    return run_fingerprint(mesh=mesh, extra=(
+        norm,
+        f"n_slots={n_slots}",
+        f"temperature={temperature}",
+        f"top_k={top_k}",
+        f"kv_dtype={kv_dtype}",
+        f"prefix_cache={prefix_cache}",
+        AUTOTUNE_VERSION,
+    ))
+
+
+def tuned_path(out_dir: str, fingerprint: str) -> str:
+    return os.path.join(out_dir, f"autotune_{fingerprint}.json")
+
+
+def save_tuned(out_dir: str, tuned: TunedConfig) -> str:
+    """Atomic JSON write (tmp + rename) so a reader never sees a torn
+    file; returns the path."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = tuned_path(out_dir, tuned.fingerprint)
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(dataclasses.asdict(tuned), f, indent=2)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_tuned(out_dir: str, fingerprint: str) -> Optional[TunedConfig]:
+    """The tuned config for ``fingerprint``, or None.
+
+    None covers EVERY miss mode — no directory, no file, unparseable
+    JSON, missing fields, or a recorded fingerprint that does not match
+    the requested one (a stale file from another environment). Loading
+    must never crash engine construction: an untuned engine is correct,
+    just default-configured."""
+    try:
+        with open(tuned_path(out_dir, fingerprint)) as f:
+            rec = json.load(f)
+        if rec.get("fingerprint") != fingerprint:
+            return None
+        return TunedConfig(
+            block_len=int(rec["block_len"]),
+            prefill_chunk=int(rec["prefill_chunk"]),
+            split_s=(None if rec.get("split_s") is None
+                     else int(rec["split_s"])),
+            fingerprint=rec["fingerprint"],
+            backend=str(rec.get("backend", "unknown")),
+            decode_tok_s=float(rec.get("decode_tok_s", 0.0)),
+            decode_bound=rec.get("decode_bound"),
+            candidates=tuple(rec.get("candidates", ())),
+        )
+    except Exception:
+        return None
+
+
+def _time_candidate(config, params, n_slots, *, block_len, prefill_chunk,
+                    split_s, kv_dtype, temperature, top_k, prefix_cache,
+                    mesh, gather_impl, prompt, ticks) -> Dict:
+    """One candidate's measured row: build a throwaway engine, prefill
+    every slot with ``prompt``, warm the decode tick, then time ``ticks``
+    ticks — the ``bench_serving.measure_gather_ab`` methodology. The
+    roofline class comes from the decode program's cost card (the AOT
+    thunk is a jit-cache hit here: decode just ran)."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.compilecache.registry import (
+        serving_registry,
+    )
+    from pytorch_distributed_tpu.serving.engine import ChunkJob, PagedEngine
+    from pytorch_distributed_tpu.telemetry.costmodel import (
+        CostCard,
+        device_ceilings,
+        extract_costs,
+    )
+
+    prompt_len = len(prompt)
+    eng = PagedEngine(
+        config, params, n_slots, block_len=block_len,
+        prefill_chunk=prefill_chunk, split_s=split_s,
+        temperature=temperature, top_k=top_k, mesh=mesh,
+        gather_impl=gather_impl, kv_dtype=kv_dtype,
+        prefix_cache=prefix_cache,
+    )
+    for s in range(n_slots):
+        if not eng.admit(s, prompt_len, ticks + 1):
+            raise ValueError(
+                f"candidate block_len={block_len} cannot admit "
+                f"{n_slots} x (prompt {prompt_len} + {ticks + 1} ticks)"
+            )
+    # chunked prefill, the scheduler's spelling: every job carries
+    # exactly prefill_chunk tokens, the last zero-padded with last_idx
+    # marking the final real token
+    for start in range(0, prompt_len, prefill_chunk):
+        seg = prompt[start:start + prefill_chunk]
+        tokens = np.zeros((prefill_chunk,), np.int32)
+        tokens[:len(seg)] = seg
+        is_last = start + prefill_chunk >= prompt_len
+        eng.run_chunks([
+            ChunkJob(slot=s, tokens=tokens, start=start, is_last=is_last,
+                     last_idx=(prompt_len - 1 - start) if is_last else 0)
+            for s in range(n_slots)
+        ])
+    positions = np.full(n_slots, prompt_len, np.int32)
+    active = np.ones(n_slots, bool)
+    key = jax.random.key(0)
+    _tokens, positions = eng.decode(positions, active, key)  # warm
+    times = []
+    for _ in range(ticks):
+        t0 = time.perf_counter()
+        _tokens, positions = eng.decode(positions, active, key)
+        times.append(time.perf_counter() - t0)
+    total = sum(times)
+    # roofline join for the decode program only (chunk programs are not
+    # what the sweep optimizes) — a backend without analysis still rows
+    bound = None
+    try:
+        reg = serving_registry(eng)
+        spec = next(s for s in reg if s.name == eng.DECODE_PROGRAM)
+        card = CostCard(program=spec.name)
+        for k, v in extract_costs(spec.aot()).items():
+            setattr(card, k, v)
+        card.calls, card.total_s = ticks, total
+        rec = card.record(*device_ceilings())
+        bound = rec.get("bound")
+    except Exception:
+        pass
+    return {
+        "block_len": block_len,
+        "prefill_chunk": prefill_chunk,
+        "split_s": split_s,
+        "decode_tok_s": round(n_slots * ticks / total, 1),
+        "decode_tick_p95_ms": round(
+            float(np.percentile(times, 95)) * 1e3, 3
+        ),
+        "decode_bound": bound,
+    }
+
+
+def sweep(config, params, n_slots: int, *,
+          block_lens: Sequence[int] = (16,),
+          prefill_chunks: Sequence[int] = (128,),
+          split_ss: Sequence[Optional[int]] = (1, None),
+          kv_dtype: Optional[str] = None, temperature: float = 0.0,
+          top_k: Optional[int] = None, prefix_cache: bool = False,
+          mesh=None, gather_impl: Optional[str] = None,
+          prompt_len: int = 32, ticks: int = 8,
+          out_dir: Optional[str] = None) -> TunedConfig:
+    """Time every candidate in the cross product, pick the highest
+    decode tok/s, and (when ``out_dir`` is given) persist the winner
+    keyed by ``autotune_fingerprint``. Candidates that cannot serve the
+    probe workload (admission fails — e.g. a block_len too coarse for
+    the pool) are skipped, not fatal; at least one candidate must
+    survive."""
+    import jax
+    import numpy as np
+
+    # Fold gather_impl into the config EXACTLY like PagedEngine does, so
+    # the fingerprint computed here equals the one a later engine (which
+    # replaces before keying) will look up.
+    if gather_impl is not None and gather_impl != config.gather_impl:
+        config = dataclasses.replace(config, gather_impl=gather_impl)
+    gather_impl = None
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, config.vocab_size, prompt_len).astype(np.int32)
+    rows: List[Dict] = []
+    for bl in block_lens:
+        for pc in prefill_chunks:
+            for ss in split_ss:
+                try:
+                    rows.append(_time_candidate(
+                        config, params, n_slots, block_len=bl,
+                        prefill_chunk=pc, split_s=ss, kv_dtype=kv_dtype,
+                        temperature=temperature, top_k=top_k,
+                        prefix_cache=prefix_cache, mesh=mesh,
+                        gather_impl=gather_impl, prompt=prompt,
+                        ticks=ticks,
+                    ))
+                except ValueError:
+                    continue  # unservable candidate: skipped, recorded not
+    if not rows:
+        raise ValueError("no autotune candidate could serve the probe "
+                         "workload")
+    best = max(rows, key=lambda r: r["decode_tok_s"])
+    fp = autotune_fingerprint(
+        config, n_slots, kv_dtype=kv_dtype, temperature=temperature,
+        top_k=top_k, prefix_cache=prefix_cache, mesh=mesh,
+    )
+    tuned = TunedConfig(
+        block_len=int(best["block_len"]),
+        prefill_chunk=int(best["prefill_chunk"]),
+        split_s=best["split_s"],
+        fingerprint=fp,
+        backend=jax.default_backend(),
+        decode_tok_s=float(best["decode_tok_s"]),
+        decode_bound=best.get("decode_bound"),
+        candidates=tuple(rows),
+    )
+    if out_dir is not None:
+        save_tuned(out_dir, tuned)
+    return tuned

@@ -17,11 +17,18 @@ the ResNet step, now produced by the runtime for every program
 
 Caveats, stated on the card rather than hidden:
 
-- XLA's ``bytes accessed`` double-counts fused intermediates (PERF_NOTES
-  §9 measured 40.6 GB reported vs 23.3 GB real HBM traffic), so achieved
-  GB/s derived from it is an UPPER bound on real traffic — fine for
+- XLA's ``bytes accessed`` double-counts fused intermediates and
+  aliased (donated) operands (PERF_NOTES §9 measured 40.6 GB reported
+  vs 23.3 GB real HBM traffic). Round 20 subtracts the part the
+  compiler itself reports — ``memory_analysis().alias_size_in_bytes``,
+  the donated-operand overlap counted once as an argument and again as
+  an output — into ``bytes_accessed_dedup``, which all derived rates
+  (intensity, achieved GB/s, hbm_frac, the roofline bound) now use.
+  The raw ``bytes_accessed`` stays on the card for comparability. The
+  fusion share of the double-count is not separable from the analysis,
+  so deduped GB/s is still an upper bound on real traffic — fine for
   *classification* (a program the metric calls bandwidth-bound is), a
-  known overestimate for absolute bandwidth.
+  smaller overestimate for absolute bandwidth.
 - Measured seconds are host wall around the dispatch (the spans the run
   already records). Programs whose results the caller materializes
   (decode tick, epoch-synced train steps) are honest; pure-dispatch
@@ -214,12 +221,17 @@ def extract_costs(compiled) -> dict:
             arg = int(getattr(ma, "argument_size_in_bytes", 0))
             outb = int(getattr(ma, "output_size_in_bytes", 0))
             tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+            alias = int(getattr(ma, "alias_size_in_bytes", 0))
             out["argument_bytes"] = arg
             out["output_bytes"] = outb
             out["temp_bytes"] = tmp
+            out["alias_bytes"] = alias
             # live working set while the program runs — the number that
-            # decides whether two programs can overlap in HBM
-            out["peak_bytes"] = arg + outb + tmp
+            # decides whether two programs can overlap in HBM. Donated
+            # operands (the pool, the logits buffer) appear in BOTH the
+            # argument and output totals but occupy one allocation, so
+            # the aliased overlap is subtracted once.
+            out["peak_bytes"] = arg + outb + tmp - alias
     except Exception:
         pass
     return out
@@ -235,17 +247,28 @@ class CostCard:
     argument_bytes: Optional[int] = None
     output_bytes: Optional[int] = None
     temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
     peak_bytes: Optional[int] = None
     # measured join (ProgramTimes): host wall attributed to this program
     calls: int = 0
     total_s: float = 0.0
 
     @property
-    def intensity(self) -> Optional[float]:
-        """Arithmetic intensity, FLOP per byte accessed."""
-        if not self.flops or not self.bytes_accessed:
+    def bytes_accessed_dedup(self) -> Optional[float]:
+        """``bytes accessed`` minus the aliased (donated) operand bytes
+        XLA counted twice — the traffic figure every derived rate uses
+        (PERF_NOTES §9). Floored at zero: the analysis pair comes from
+        two separate compiler queries and is not guaranteed coherent."""
+        if self.bytes_accessed is None:
             return None
-        return self.flops / self.bytes_accessed
+        return max(self.bytes_accessed - (self.alias_bytes or 0), 0.0)
+
+    @property
+    def intensity(self) -> Optional[float]:
+        """Arithmetic intensity, FLOP per deduped byte accessed."""
+        if not self.flops or not self.bytes_accessed_dedup:
+            return None
+        return self.flops / self.bytes_accessed_dedup
 
     def record(self, peak_flops: Optional[float] = None,
                peak_bytes_s: Optional[float] = None) -> dict:
@@ -253,10 +276,13 @@ class CostCard:
         measured join, and every derived rate the ceilings allow."""
         rec: dict = {"program": self.program, "calls": self.calls}
         for k in ("flops", "bytes_accessed", "argument_bytes",
-                  "output_bytes", "temp_bytes", "peak_bytes"):
+                  "output_bytes", "temp_bytes", "alias_bytes",
+                  "peak_bytes"):
             v = getattr(self, k)
             if v is not None:
                 rec[k] = v
+        if self.bytes_accessed_dedup is not None:
+            rec["bytes_accessed_dedup"] = self.bytes_accessed_dedup
         if self.intensity is not None:
             rec["intensity_flop_b"] = round(self.intensity, 3)
         if self.calls and self.total_s > 0:
@@ -267,11 +293,11 @@ class CostCard:
                 rec["achieved_flops_s"] = self.flops / mean_s
                 if peak_flops:
                     rec["mfu"] = round(self.flops / mean_s / peak_flops, 5)
-            if self.bytes_accessed:
-                rec["achieved_bytes_s"] = self.bytes_accessed / mean_s
+            if self.bytes_accessed_dedup:
+                rec["achieved_bytes_s"] = self.bytes_accessed_dedup / mean_s
                 if peak_bytes_s:
                     rec["hbm_frac"] = round(
-                        self.bytes_accessed / mean_s / peak_bytes_s, 5
+                        self.bytes_accessed_dedup / mean_s / peak_bytes_s, 5
                     )
         if peak_flops and peak_bytes_s and self.intensity is not None:
             ridge = peak_flops / peak_bytes_s
@@ -342,11 +368,14 @@ def build_cost_cards(registry, times: Optional[ProgramTimes] = None,
 
 
 def log_cost_cards(registry, times, metrics_log, *,
-                   fingerprint: Optional[str] = None) -> List[dict]:
+                   fingerprint: Optional[str] = None,
+                   annotate: Optional[dict] = None) -> List[dict]:
     """Build every card, join, and emit one ``kind="program_cost"``
     JSONL record per program. Returns the records (emitted or not — a
     ``metrics_log`` of None still returns them for callers that render
-    directly)."""
+    directly). ``annotate`` merges extra keys into every record — the
+    scheduler passes the engine's tuned-config provenance so forensics
+    can tell which kernel variant actually served."""
     peak_flops, peak_bytes_s = device_ceilings()
     records = []
     for card in build_cost_cards(registry, times):
@@ -354,6 +383,8 @@ def log_cost_cards(registry, times, metrics_log, *,
         rec["fingerprint"] = (
             fingerprint if fingerprint is not None else registry.fingerprint
         )
+        if annotate:
+            rec.update(annotate)
         records.append(rec)
         if metrics_log is not None:
             metrics_log.log(kind="program_cost", **rec)

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Kernel autotune sweep CLI (telemetry/autotune.py front-end).
+
+Times every candidate (block_len × prefill_chunk × split_s) serving
+config with the warm-decode-tick methodology of
+``bench_serving.py --gather-ab``, joins each candidate with its decode
+program's cost-card roofline class, and persists the winner keyed by
+the autotune fingerprint — the registry fingerprint with the tuned
+knobs normalized out. Any engine later constructed with
+``autotune_dir=`` (or env ``PDT_AUTOTUNE_DIR``) pointing at ``--out-dir``
+and matching the fingerprint loads the winner automatically.
+
+Examples::
+
+    # tiny CPU smoke: sweep two block lengths and the split knob
+    python scripts/autotune.py --tiny --out-dir /tmp/tuned \
+        --block-lens 8,16 --split-ss 1,2 --json
+
+    # GPT-2 shape, fp8 pool, pallas gather (run on the TPU you serve on:
+    # the fingerprint binds the file to that backend/device)
+    python scripts/autotune.py --out-dir /tmp/tuned \
+        --gather-impl pallas --kv-dtype fp8
+
+HONESTY: the tuned file records the backend it was MEASURED on; a sweep
+run on the CPU backend timed the Pallas interpreter and its winner is a
+plumbing artifact, not a TPU performance claim (same rule as the
+``gather_ab_backend`` bench rows).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _ints(text):
+    return tuple(int(x) for x in text.split(",") if x)
+
+
+def _splits(text):
+    # "1,2,auto" — 'auto' means split_s=None (the threshold policy)
+    out = []
+    for x in text.split(","):
+        x = x.strip()
+        if not x:
+            continue
+        out.append(None if x == "auto" else int(x))
+    return tuple(out)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny fp32 model (CPU smoke) instead of GPT-2")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--ticks", type=int, default=8,
+                   help="timed decode ticks per candidate (one extra "
+                        "untimed tick warms each program)")
+    p.add_argument("--prompt-len", type=int, default=32)
+    p.add_argument("--block-lens", type=_ints, default=(8, 16),
+                   metavar="N,N,...")
+    p.add_argument("--prefill-chunks", type=_ints, default=(32,),
+                   metavar="N,N,...")
+    p.add_argument("--split-ss", type=_splits, default=(1, 2),
+                   metavar="N|auto,...",
+                   help="split-S candidates; 'auto' = the W/B threshold "
+                        "policy")
+    p.add_argument("--gather-impl", choices=("dense", "pallas"),
+                   default="pallas")
+    p.add_argument("--kv-dtype", choices=("int8", "fp8", "fp8_e5m2"),
+                   default=None)
+    p.add_argument("--out-dir", required=True,
+                   help="directory the tuned JSON is written into "
+                        "(autotune_<fingerprint>.json)")
+    p.add_argument("--json", action="store_true",
+                   help="print the tuned config as JSON")
+    args = p.parse_args()
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        tiny_config,
+    )
+    from pytorch_distributed_tpu.telemetry.autotune import sweep, tuned_path
+
+    if args.tiny:
+        # same shape as bench_serving._tiny_model so a sweep here feeds
+        # the --gather-ab --tuned A/B (fingerprints must agree)
+        cfg = tiny_config(attention="dense", max_seq_len=256,
+                          dtype=jnp.float32)
+    else:
+        cfg = TransformerConfig(
+            vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
+            max_seq_len=1024, dtype=jnp.bfloat16, attention="dense",
+        )
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    tuned = sweep(
+        cfg, params, args.slots,
+        block_lens=args.block_lens,
+        prefill_chunks=args.prefill_chunks,
+        split_ss=args.split_ss,
+        kv_dtype=args.kv_dtype,
+        gather_impl=args.gather_impl,
+        prompt_len=args.prompt_len,
+        ticks=args.ticks,
+        out_dir=args.out_dir,
+    )
+    path = tuned_path(args.out_dir, tuned.fingerprint)
+    if args.json:
+        print(json.dumps(dataclasses.asdict(tuned), indent=2))
+    else:
+        print(f"winner: block_len={tuned.block_len} "
+              f"prefill_chunk={tuned.prefill_chunk} "
+              f"split_s={tuned.split_s} "
+              f"({tuned.decode_tok_s} tok/s, bound={tuned.decode_bound}, "
+              f"backend={tuned.backend}, "
+              f"{len(tuned.candidates)} candidates)")
+        print(f"saved: {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,7 +79,11 @@ def _fleet(cfg, params, async_host, **extra):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("mode", ["plain", "disagg", "pressure"])
+@pytest.mark.parametrize("mode", [
+    pytest.param("plain", marks=pytest.mark.slow),
+    "disagg",
+    pytest.param("pressure", marks=pytest.mark.slow),
+])
 def test_async_sync_token_identity(model, mode):
     """Bit-identical greedy token streams between the synchronous loop
     and dispatch-then-collect, on the plain fleet, the disaggregated
@@ -117,6 +121,7 @@ def test_async_sync_token_identity(model, mode):
         assert not s.has_uncollected
 
 
+@pytest.mark.slow
 def test_async_identity_on_bursty_trace(model):
     """The smoke-trace identity gate: a seeded bursty trace replayed
     through both loops at the same per-tick load — same served rid set,

@@ -1,0 +1,186 @@
+"""Cost-card autotuner (round 20 tentpole d): sweep → persist → reload
+keyed by fingerprint. The contract under test: the tuned file's key
+excludes the knobs being tuned (an engine can find it BEFORE choosing
+block_len/split_s), a matching engine loads it with zero new jit-cache
+entries and full registry coverage, and every miss mode — stale
+fingerprint, corrupt file, absent directory — is a clean default-config
+construction, never a crash."""
+
+import dataclasses
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.analysis import no_recompile
+from pytorch_distributed_tpu.compilecache import serving_registry
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM,
+    tiny_config,
+)
+from pytorch_distributed_tpu.serving.engine import ChunkJob, PagedEngine
+from pytorch_distributed_tpu.telemetry.autotune import (
+    TunedConfig,
+    autotune_fingerprint,
+    load_tuned,
+    save_tuned,
+    sweep,
+    tuned_path,
+)
+
+
+def setup(max_seq_len=64, **over):
+    cfg = tiny_config(attention="dense", max_seq_len=max_seq_len, **over)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def _serve_cycle(eng, prompt_len=8, ticks=3):
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, eng.config.vocab_size,
+                          prompt_len).astype(np.int32)
+    assert eng.admit(0, prompt_len, ticks + 1)
+    for start in range(0, prompt_len, eng.chunk):
+        seg = prompt[start:start + eng.chunk]
+        toks = np.zeros((eng.chunk,), np.int32)
+        toks[:len(seg)] = seg
+        last = start + eng.chunk >= prompt_len
+        eng.run_chunks([ChunkJob(
+            slot=0, tokens=toks, start=start, is_last=last,
+            last_idx=(prompt_len - 1 - start) if last else 0,
+        )])
+    pos = np.zeros(eng.n_slots, np.int32)
+    pos[0] = prompt_len
+    act = np.zeros(eng.n_slots, bool)
+    act[0] = True
+    key = jax.random.key(1)
+    for _ in range(ticks):
+        _t, pos = eng.decode(pos, act, key)
+
+
+def test_fingerprint_excludes_tuned_knobs():
+    """The keying rule: the tuned parameters must not key their own
+    file. Configs differing only in split_s map to ONE fingerprint;
+    anything that changes the program family (kv_dtype, gather_impl,
+    n_slots) maps to a different one."""
+    cfg, _ = setup()
+    base = autotune_fingerprint(cfg, 2, kv_dtype=None)
+    assert autotune_fingerprint(
+        dataclasses.replace(cfg, split_s=4), 2, kv_dtype=None
+    ) == base
+    assert autotune_fingerprint(cfg, 2, kv_dtype="fp8") != base
+    assert autotune_fingerprint(cfg, 4, kv_dtype=None) != base
+    assert autotune_fingerprint(
+        dataclasses.replace(cfg, gather_impl="pallas"), 2, kv_dtype=None
+    ) != base
+
+
+@pytest.mark.slow
+def test_sweep_round_trip_engine_loads_tuned(tmp_path):
+    """THE acceptance loop: sweep two candidates → winner persisted →
+    a fresh same-shape engine loads it (tuned knobs applied, provenance
+    says so), serves, and its registry covers every compiled program
+    with the decode tick no_recompile-clean after warmup."""
+    cfg, params = setup()
+    out = str(tmp_path)
+    tuned = sweep(
+        cfg, params, 2, block_lens=(8, 16), prefill_chunks=(8,),
+        split_ss=(1,), gather_impl="pallas", prompt_len=8, ticks=2,
+        out_dir=out,
+    )
+    assert tuned.backend == jax.default_backend()
+    assert len(tuned.candidates) == 2
+    # file round-trips bit-for-bit through the loader
+    again = load_tuned(out, tuned.fingerprint)
+    assert again == tuned
+
+    eng = PagedEngine(cfg, params, 2, gather_impl="pallas",
+                      autotune_dir=out)
+    assert eng.tuned is not None
+    assert eng.block_len == tuned.block_len
+    assert eng.chunk == tuned.prefill_chunk
+    assert eng.config.split_s == tuned.split_s
+    prov = eng.tuned_provenance()
+    assert prov["tuned"] and prov["tuned_match"]
+    assert prov["tuned_fingerprint"] == tuned.fingerprint
+
+    _serve_cycle(eng)
+    serving_registry(eng).assert_covers(eng.compiled_program_names())
+    # decode is warm: wrapping it in the guard and ticking further must
+    # add zero jit-cache entries (the tuned config compiled exactly the
+    # predicted programs, nothing drifts per tick)
+    eng._decode_fn = no_recompile(eng._decode(), warmup_steps=1)
+    pos = np.full(2, 11, np.int32)
+    act = np.array([True, False])
+    key = jax.random.key(2)
+    for _ in range(3):
+        _t, pos = eng.decode(pos, act, key)
+    assert eng._decode_fn.stats.recompiles_after_warmup == 0
+
+
+def test_stale_fingerprint_is_clean_miss(tmp_path):
+    """A tuned file from ANOTHER environment/shape must not load: the
+    engine constructs with defaults, flags tuned_match False, and
+    nothing raises."""
+    cfg, params = setup()
+    out = str(tmp_path)
+    fp = autotune_fingerprint(cfg, 2, kv_dtype=None)
+    save_tuned(out, TunedConfig(
+        block_len=8, prefill_chunk=8, split_s=2, fingerprint=fp,
+        backend="cpu", decode_tok_s=1.0,
+    ))
+    # direct loader: wrong fingerprint → None
+    assert load_tuned(out, "0" * 16) is None
+    # engine with a DIFFERENT shape (n_slots) keys a different
+    # fingerprint → clean miss, defaults kept
+    eng = PagedEngine(cfg, params, 4, autotune_dir=out)
+    assert eng.tuned is None
+    assert eng.block_len == 16 and eng.chunk == 128
+    prov = eng.tuned_provenance()
+    assert prov["tuned"] is False and prov["tuned_match"] is False
+    # matching shape → hit (the file above was keyed for n_slots=2)
+    hit = PagedEngine(cfg, params, 2, autotune_dir=out)
+    assert hit.tuned is not None and hit.block_len == 8
+
+
+def test_corrupt_and_absent_files_are_clean_misses(tmp_path):
+    cfg, params = setup()
+    out = str(tmp_path)
+    fp = autotune_fingerprint(cfg, 2, kv_dtype=None)
+    # absent dir / absent file
+    assert load_tuned(str(tmp_path / "nope"), fp) is None
+    assert load_tuned(out, fp) is None
+    # torn/corrupt JSON
+    with open(tuned_path(out, fp), "w") as f:
+        f.write('{"block_len": 8, "prefill_ch')
+    assert load_tuned(out, fp) is None
+    # parseable but missing required fields
+    with open(tuned_path(out, fp), "w") as f:
+        json.dump({"fingerprint": fp}, f)
+    assert load_tuned(out, fp) is None
+    eng = PagedEngine(cfg, params, 2, autotune_dir=out)
+    assert eng.tuned is None  # corrupt file: default engine, no crash
+
+
+def test_explicit_args_win_over_tuned(tmp_path):
+    """A caller who PASSES block_len/split_s gets those values even when
+    a tuned file matches — the file fills in defaults, it does not
+    override explicit choices."""
+    cfg, params = setup()
+    out = str(tmp_path)
+    fp = autotune_fingerprint(cfg, 2, kv_dtype=None)
+    save_tuned(out, TunedConfig(
+        block_len=8, prefill_chunk=16, split_s=2, fingerprint=fp,
+        backend="cpu", decode_tok_s=1.0,
+    ))
+    eng = PagedEngine(cfg, params, 2, block_len=32, prefill_chunk=64,
+                      split_s=1, autotune_dir=out)
+    assert eng.tuned is not None  # the file DID match...
+    assert eng.block_len == 32  # ...but explicit arguments held
+    assert eng.chunk == 64
+    assert eng.config.split_s == 1

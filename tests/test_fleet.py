@@ -319,6 +319,7 @@ def test_handoff_requires_flag():
     assert eng.handoff_buckets() == []  # registry predicts none
 
 
+@pytest.mark.slow
 def test_disagg_token_identical_to_colocated():
     cfg, params = setup()
     rng = np.random.default_rng(0)

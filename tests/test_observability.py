@@ -13,6 +13,7 @@ The load-bearing proofs:
 - the fleet SLOGate treats a recently-anomalous replica as hot.
 """
 
+import functools
 import json
 import os
 import subprocess
@@ -157,6 +158,67 @@ def test_cost_card_join_arithmetic_and_roofline_class():
     assert rec4["calls"] == 0 and "mean_s" not in rec4
 
 
+def test_extract_costs_dedupes_aliased_operand_bytes():
+    """The round 20 double-count fix (PERF_NOTES §9): donated operands
+    appear in BOTH argument and output totals, so peak_bytes subtracts
+    the aliased overlap once and bytes_accessed_dedup removes it from
+    the traffic number the roofline join divides by. Regression pinned
+    against a fake compiled object with known analysis values."""
+
+    class FakeMem:
+        argument_size_in_bytes = 1000
+        output_size_in_bytes = 700
+        temp_size_in_bytes = 50
+        alias_size_in_bytes = 600  # a donated pool counted twice above
+
+    class FakeCompiled:
+        def cost_analysis(self):
+            return [{"flops": 4000.0, "bytes accessed": 2000.0}]
+
+        def memory_analysis(self):
+            return FakeMem()
+
+    costs = extract_costs(FakeCompiled())
+    assert costs["alias_bytes"] == 600
+    assert costs["peak_bytes"] == 1000 + 700 + 50 - 600
+    card = CostCard(program="fake", calls=2, total_s=0.2, **costs)
+    assert card.bytes_accessed_dedup == pytest.approx(2000.0 - 600)
+    # intensity and the roofline join use the DEDUPED traffic
+    assert card.intensity == pytest.approx(4000.0 / 1400.0)
+    rec = card.record(peak_flops=1e6, peak_bytes_s=1e5)
+    assert rec["bytes_accessed"] == pytest.approx(2000.0)  # raw kept
+    assert rec["bytes_accessed_dedup"] == pytest.approx(1400.0)
+    assert rec["achieved_bytes_s"] == pytest.approx(1400.0 / 0.1)
+    assert rec["hbm_frac"] == pytest.approx(1400.0 / 0.1 / 1e5)
+    # no alias info → dedup degrades to the raw number, never negative
+    plain = CostCard(program="p", flops=1.0, bytes_accessed=100.0)
+    assert plain.bytes_accessed_dedup == pytest.approx(100.0)
+    swamped = CostCard(program="s", bytes_accessed=100.0,
+                       alias_bytes=1000)
+    assert swamped.bytes_accessed_dedup == 0.0
+
+
+def test_extract_costs_alias_on_real_donated_program():
+    """A live donated buffer really shows up in alias_size_in_bytes and
+    peak_bytes stays below the naive arg+out+temp sum (tolerant: if
+    this jax build reports no aliasing, the dedup must be a no-op
+    rather than wrong)."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def bump(x):
+        return x + 1
+
+    comp = bump.lower(jnp.ones((256, 256), jnp.float32)).compile()
+    costs = extract_costs(comp)
+    naive = (costs["argument_bytes"] + costs["output_bytes"]
+             + costs["temp_bytes"])
+    assert costs["peak_bytes"] == naive - costs["alias_bytes"]
+    if costs["alias_bytes"]:
+        assert costs["alias_bytes"] >= 256 * 256 * 4
+        card = CostCard(program="bump", **costs)
+        assert card.bytes_accessed_dedup < card.bytes_accessed
+
+
 def _tiny_scheduler(**kw):
     from pytorch_distributed_tpu.models.transformer import (
         TransformerLM,
@@ -172,6 +234,7 @@ def _tiny_scheduler(**kw):
                           prefill_chunk=8, **kw)
 
 
+@pytest.mark.slow
 def test_every_registry_program_has_a_cost_card(tmp_path):
     """The acceptance line: cards cover the registry exactly, and the
     measured decode tick joins into achieved rates."""
@@ -381,6 +444,7 @@ def _lm_fit(tmp_path, monkeypatch, fault_plan=None, watcher=None,
                for l in open(os.path.join(tmp_path, "metrics.jsonl"))]
 
 
+@pytest.mark.slow
 def test_trainer_hang_injection_flags_anomaly_and_cost_cards(
     tmp_path, monkeypatch
 ):
@@ -435,6 +499,7 @@ def test_trainer_hang_injection_flags_anomaly_and_cost_cards(
     assert out["anomalies"] >= 1
 
 
+@pytest.mark.slow
 def test_trainer_suspend_dumps_flight_recorder(tmp_path, monkeypatch):
     """The suspend trigger: a latched suspend leaves an atomic ring dump
     (reason=suspend) before the run yields."""
@@ -470,6 +535,7 @@ def test_trainer_suspend_dumps_flight_recorder(tmp_path, monkeypatch):
 
 
 @pytest.mark.crash
+@pytest.mark.slow
 def test_kill_matrix_child_leaves_readable_flightrec_mirror(tmp_path):
     """ISSUE 8 acceptance: SIGKILL the crash child at a train.step fault
     point; the relaunch-visible mirror must parse, and its last step

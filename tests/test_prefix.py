@@ -421,6 +421,7 @@ def _replay(router, trace, cfg, prefix_len=24):
     return dict(router.results)
 
 
+@pytest.mark.slow
 def test_prefix_fleet_and_disagg_handoff_identity(tmp_path):
     """Shared-prefix chains cross the disaggregated prefill→decode
     handoff intact (export gathers shared blocks, the decode pool gets

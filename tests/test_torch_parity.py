@@ -108,6 +108,7 @@ def test_export_roundtrip_bit_exact():
     assert all(k.endswith("num_batches_tracked") for k in missing)
 
 
+@pytest.mark.slow
 def test_sgd_loss_trajectory_matches_torch():
     """Identical init + identical batches + the same SGD(momentum, wd) rule
     ⇒ the same loss trajectory, through batch-norm train mode and all."""
