@@ -39,9 +39,11 @@ from pytorch_distributed_tpu.fleet.traffic import (
     TraceRequest,
     clamp_trace,
     generate_trace,
+    iter_trace,
     load_trace,
     prompt_for,
     shared_prefix_prompt_for,
+    replay_stream,
     replay_trace,
     save_trace,
 )
@@ -60,9 +62,11 @@ __all__ = [
     "TraceRequest",
     "clamp_trace",
     "generate_trace",
+    "iter_trace",
     "load_trace",
     "prompt_for",
     "shared_prefix_prompt_for",
+    "replay_stream",
     "replay_trace",
     "save_trace",
 ]

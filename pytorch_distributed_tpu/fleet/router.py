@@ -144,6 +144,7 @@ class FleetRouter:
                  tick_deadline_s: Optional[float] = None,
                  redispatch_max_attempts: int = 3,
                  redispatch_base_delay_s: float = 0.05,
+                 retain_results: bool = True,
                  **scheduler_kwargs):
         import jax
 
@@ -256,6 +257,22 @@ class FleetRouter:
         self.placement: Dict[int, int] = {}  # rid -> current replica
         self.rejected: Dict[int, str] = {}  # rid -> shed reason
         self.results: Dict[int, List[int]] = {}
+        # round 21 (scale observatory): retention mode. The default
+        # keeps every rid's token list forever — ``drain()`` returns
+        # the full results dict, the redispatch replay reads it as the
+        # authoritative delivered stream, and benches assert equality
+        # on it; all O(sessions ever). ``retain_results=False`` is the
+        # soak/streaming mode: callers consume ``step()``'s (rid, tok)
+        # pairs live, and the router drops a rid's results/placement
+        # entries once it retires — host state stays O(live requests).
+        # Trade-off: a replica death then re-delivers the tokens the
+        # retired-entry replay would have skipped, so streaming mode is
+        # for fault-free soaks and dedup-capable consumers. ``rejected``
+        # and ``failed`` keep only the most recent ``_REJECT_CAP``
+        # entries in this mode (counters stay exact).
+        self.retain_results = bool(retain_results)
+        self._retired_pending: List[int] = []
+        self._results_dropped = 0
         self._spilled = 0
         self._preempt_routes = 0
         self._handoff_count = 0
@@ -313,6 +330,12 @@ class FleetRouter:
         #: attempt cap was exhausted. Disjoint from ``rejected`` (never
         #: admitted) — a failed rid may have streamed partial tokens.
         self.failed: Dict[int, str] = {}
+        # monotonic twins of ``len(rejected)``/``len(failed)``: the
+        # streaming-mode trim drops old REASONS, so the headline shed/
+        # failed counts must not be derived from table length (round 21
+        # fix — metrics() undercounted past _REJECT_CAP sheds)
+        self._shed_total = 0
+        self._failed_total = 0
         self._redispatched = 0
         self._deadline_expired_redispatch = 0
         self._deadline_sheds = 0
@@ -357,7 +380,7 @@ class FleetRouter:
         kw = dict(self._scheduler_kwargs)
         if role == "decode" and self._decode_slots is not None:
             kw["n_slots"] = self._decode_slots
-        return Scheduler(
+        s = Scheduler(
             self._config, self._params, replica_id=i,
             seed=self._seed + i, prefill_only=(role == "prefill"),
             device=dev, handoff=self._disaggregate,
@@ -366,6 +389,8 @@ class FleetRouter:
             ledger=self.ledger, host_pool=self.host_pool,
             blocksan=self.blocksan, **kw,
         )
+        s.on_retire = self._note_retire
+        return s
 
     # ---- health plane ----
 
@@ -415,6 +440,141 @@ class FleetRouter:
         rec["consecutive"] = 0
         if rec["state"] == "suspect":
             self._set_health(i, "healthy", "tick-recovered")
+
+    # ---- retention plane (round 21) ----
+
+    #: most-recent shed/failed entries kept in streaming-retention mode
+    _REJECT_CAP = 1024
+
+    def _note_retire(self, rid: int, outcome: str) -> None:
+        """Scheduler retire hook (complete/cancel/deadline). Cleanup is
+        deferred to ``_drop_retired`` at the END of the step: the
+        retirement fires mid-collect, and the router appends the final
+        token to ``results`` after collect returns — popping here would
+        resurrect a one-token entry per retired rid."""
+        # a completed rid can never be harvested again; its re-dispatch
+        # origin facts are dead weight in EVERY retention mode (real
+        # leak: one entry per redispatched-then-completed rid, forever)
+        self._origin.pop(rid, None)
+        if not self.retain_results:
+            self._retired_pending.append(rid)
+
+    def _drop_retired(self) -> None:
+        if self.retain_results or not self._retired_pending:
+            return
+        for rid in self._retired_pending:
+            if self.results.pop(rid, None) is not None:
+                self._results_dropped += 1
+            self.placement.pop(rid, None)
+        self._retired_pending.clear()
+
+    def _trim_rejects(self) -> None:
+        """Streaming mode: ``rejected``/``failed`` keep reasons for
+        recent rids only (counters remain exact)."""
+        if self.retain_results:
+            return
+        for table in (self.rejected, self.failed):
+            while len(table) > self._REJECT_CAP:
+                table.pop(next(iter(table)))
+
+    def live_requests(self) -> int:
+        """Fleet-wide in-flight request count: every replica's queued +
+        resident + parked + mid-swap population, plus harvested rids
+        awaiting re-dispatch — the census sweep's O(live) audit axis."""
+        return (sum(s.live_requests() for s in self.replicas)
+                + len(self._pending_redispatch))
+
+    def census_decls(self):
+        """Round 21 scale observatory: every long-lived container on
+        the router declares its bound (telemetry/census.py). The
+        rid-keyed tables are the interesting ones — unbounded by design
+        under the default drain() contract, proven O(live) in
+        streaming-retention mode."""
+        from pytorch_distributed_tpu.telemetry.census import Decl
+
+        def _retention(kind_live):
+            return lambda r: kind_live if not r.retain_results \
+                else "unbounded"
+
+        return [
+            Decl("replicas", "replicas", cap=lambda r: len(r.health),
+                 why="one Scheduler per replica slot"),
+            Decl("roles", "replicas", cap=lambda r: len(r.health),
+                 why="role string per replica slot"),
+            Decl("entry_group", "replicas", cap=lambda r: len(r.health),
+                 why="subset of replica indices"),
+            Decl("decode_group", "replicas", cap=lambda r: len(r.health),
+                 why="subset of replica indices"),
+            Decl("health", "replicas", cap=lambda r: len(r.health),
+                 why="health record per replica slot, survives revive"),
+            Decl("_affinity", "fixed", cap=lambda r: r.affinity_cap,
+                 why="session→replica LRU, capped since round 17 (the "
+                     "round-21 census proves the cap holds under soak)"),
+            Decl("placement", _retention("live"),
+                 why="rid→replica for in-flight rids; streaming mode "
+                     "drops entries at retire, default mode keeps them "
+                     "for the drain()/replay contract"),
+            Decl("results", _retention("live"), per_live=1,
+                 why="delivered-token record; the redispatch replay's "
+                     "authoritative stream in default mode, dropped at "
+                     "retire in streaming mode"),
+            Decl("rejected",
+                 lambda r: "unbounded" if r.retain_results else "fixed",
+                 cap=lambda r: None if r.retain_results
+                 else r._REJECT_CAP + 64,
+                 why="shed reasons; streaming mode keeps the most "
+                     "recent _REJECT_CAP (sheds counter stays exact)"),
+            Decl("failed",
+                 lambda r: "unbounded" if r.retain_results else "fixed",
+                 cap=lambda r: None if r.retain_results
+                 else r._REJECT_CAP + 64,
+                 why="redispatch-exhausted reasons; bounded like "
+                     "rejected in streaming mode"),
+            Decl("_origin", "live",
+                 why="origin facts for harvested rids only; popped on "
+                     "shed/expire AND on retire (round 21 fix — "
+                     "previously leaked one entry per "
+                     "redispatched-then-completed rid)"),
+            Decl("_pending_redispatch", "live",
+                 why="harvested rids waiting out backoff"),
+            Decl("_retired_pending", "fixed", cap=lambda r: 16384,
+                 why="retired rids queued for end-of-step cleanup; "
+                     "drained every step() / _drop_retired call"),
+            Decl("_devices", "fixed", cap=lambda r: len(r._devices) or 1,
+                 why="jax.devices() snapshot taken at construction"),
+            Decl("_scheduler_kwargs", "fixed", cap=64,
+                 why="constructor kwargs retained for revive()"),
+            Decl("_params", "fixed", cap=None,
+                 why="model parameter pytree shared by every replica; "
+                     "immutable after construction (no bound to audit, "
+                     "declared so the undeclared sweep knows it was "
+                     "considered)"),
+            Decl("handoff_lat.values", "fixed",
+                 cap=lambda r: 2 * r.handoff_lat.window,
+                 why="LatencySeries percentile window (round 21 cap)"),
+        ]
+
+    def census_owners(self):
+        """The swept (name, object) set for ``StructCensus.register_many``
+        — the router, each replica scheduler with its allocator/prefix
+        index/host store/sentinel, and the shared telemetry objects."""
+        owners = [("router", self)]
+        for i, s in enumerate(self.replicas):
+            owners.append((f"sched{i}", s))
+            owners.append((f"alloc{i}", s.engine.allocator))
+            if s.engine.prefix is not None:
+                owners.append((f"prefix{i}", s.engine.prefix))
+            owners.append((f"host_store{i}", s.host_store))
+            if s.sentinel is not None:
+                owners.append((f"sentinel{i}", s.sentinel))
+            owners.append((f"prog_times{i}", s.prog_times))
+        if self.reqtrace.enabled:
+            owners.append(("reqtrace", self.reqtrace))
+        if self.flightrec.enabled:
+            owners.append(("flightrec", self.flightrec))
+        if self.ledger.enabled:
+            owners.append(("ledger", self.ledger))
+        return owners
 
     def _note_failure(self, i: int, exc: BaseException,
                       site: str = "tick") -> None:
@@ -513,7 +673,11 @@ class FleetRouter:
         the post-admission twin of the gate's shed (the client may have
         seen partial tokens; the stream simply never completes)."""
         self.failed[rid] = reason
+        self._failed_total += 1
+        self._trim_rejects()
         self._origin.pop(rid, None)
+        if not self.retain_results:
+            self._retired_pending.append(rid)
         self.flightrec.record("request_failed", rid=rid, reason=reason)
         if self.reqtrace.enabled:
             root = self.reqtrace.open_root(rid)
@@ -531,6 +695,8 @@ class FleetRouter:
         an enforcement point just like the scheduler tick."""
         self._deadline_expired_redispatch += 1
         self._origin.pop(rid, None)
+        if not self.retain_results:
+            self._retired_pending.append(rid)
         self.flightrec.record("deadline", rid=rid, where=where)
         if self.reqtrace.enabled:
             root = self.reqtrace.open_root(rid)
@@ -583,6 +749,8 @@ class FleetRouter:
                     self.reqtrace.end(root, outcome="complete",
                                       reason="redispatch-noop")
                 self._origin.pop(rid, None)
+                if not self.retain_results:
+                    self._retired_pending.append(rid)
                 continue
             prompt = origin["prompt"]
             if delivered:
@@ -693,6 +861,8 @@ class FleetRouter:
             )
         if decision.action == SHED:
             self.rejected[rid] = decision.reason
+            self._shed_total += 1
+            self._trim_rejects()
             self.flightrec.record("shed", rid=rid, reason=decision.reason)
             if self.metrics_log is not None:
                 self.metrics_log.log(
@@ -906,6 +1076,7 @@ class FleetRouter:
                 self._pump_handoffs()
         for rid, tok in out:
             self.results.setdefault(rid, []).append(tok)
+        self._drop_retired()
         self._tick += 1
         if self._tick % 16 == 0:  # sampled: metrics() per tick is waste
             self._recommend_peak = max(self._recommend_peak,
@@ -1014,7 +1185,7 @@ class FleetRouter:
         recommendation, and flat per-replica key summaries."""
         per = [s.metrics() for s in self.replicas]
         submitted = self._next_rid
-        shed = len(self.rejected)
+        shed = self._shed_total
         placed = submitted - shed
         elapsed = (
             time.perf_counter() - self._start_time
@@ -1071,6 +1242,12 @@ class FleetRouter:
             ),
             "affinity_sessions": len(self._affinity),
             "affinity_evictions": self._affinity_evictions,
+            # round 21 retention plane: how many retired rids had their
+            # results/placement entries dropped (0 in the default
+            # keep-everything mode) and the live-request axis the
+            # census audits against
+            "results_dropped": self._results_dropped,
+            "live_requests": self.live_requests(),
             "cancelled": sum(m["cancelled"] for m in per),
             # failure-plane rollup (round 19): health census, replica
             # deaths, re-dispatch traffic, and the deadline ledger —
@@ -1084,7 +1261,7 @@ class FleetRouter:
             "replica_deaths": sum(h["deaths"] for h in self.health),
             "redispatched": self._redispatched,
             "redispatch_pending": len(self._pending_redispatch),
-            "failed": len(self.failed),
+            "failed": self._failed_total,
             "deadline_misses": sum(m["deadline_misses"] for m in per),
             "deadline_sheds": self._deadline_sheds,
             "deadline_expired_redispatch":

@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Callable, List, Optional
+from typing import Callable, Iterable, Iterator, List, Optional
 
 import numpy as np
 
@@ -114,6 +114,64 @@ def generate_trace(
             max_new=_heavy_tail(rng, max_new_median, max_new_sigma,
                                 max_new_min, max_new_max),
         ))
+
+
+def iter_trace(
+    *,
+    seed: int = 0,
+    duration_s: float = 60.0,
+    base_rate: float = 2.0,
+    burst_rate_mult: float = 4.0,
+    burst_every_s: float = 10.0,
+    burst_len_s: float = 2.0,
+    sessions: int = 16,
+    prompt_median: int = 32,
+    prompt_sigma: float = 0.8,
+    prompt_min: int = 4,
+    prompt_max: Optional[int] = None,
+    max_new_median: int = 12,
+    max_new_sigma: float = 0.6,
+    max_new_min: int = 2,
+    max_new_max: Optional[int] = None,
+    unique_sessions: bool = False,
+) -> Iterator[TraceRequest]:
+    """Streaming ``generate_trace`` — O(1) memory for 100k+-request soaks.
+
+    Yields the SAME requests as ``generate_trace`` for the same
+    parameters and seed (identical RNG draw order), without ever
+    holding the trace in a list — the round-21 soak streams a
+    million-user-shaped trace through this. ``unique_sessions=True``
+    gives every request its own session id (``session == rid``): the
+    one-query-per-user shape that stresses the affinity LRU hardest.
+    The session draw is still consumed in that mode so lengths and
+    arrival times stay seed-identical across both shapes.
+    """
+    if duration_s <= 0 or base_rate <= 0:
+        raise ValueError("duration_s and base_rate must be positive")
+    if burst_rate_mult < 1.0:
+        raise ValueError("burst_rate_mult must be >= 1 (1 = no bursts)")
+    rng = np.random.default_rng(seed)
+    rid = 0
+    t = 0.0
+    while True:
+        in_burst = (
+            burst_len_s > 0 and (t % burst_every_s) < burst_len_s
+        )
+        rate = base_rate * (burst_rate_mult if in_burst else 1.0)
+        t += float(rng.exponential(1.0 / rate))
+        if t >= duration_s:
+            return
+        session = int(rng.integers(sessions))
+        yield TraceRequest(
+            rid=rid,
+            t=t,
+            session=rid if unique_sessions else session,
+            prompt_len=_heavy_tail(rng, prompt_median, prompt_sigma,
+                                   prompt_min, prompt_max),
+            max_new=_heavy_tail(rng, max_new_median, max_new_sigma,
+                                max_new_min, max_new_max),
+        )
+        rid += 1
 
 
 def prompt_for(req: TraceRequest, vocab_size: int,
@@ -246,3 +304,42 @@ def replay_trace(
         f"replay did not converge within {max_steps} ticks "
         f"({len(trace) - i} arrivals pending)"
     )
+
+
+def replay_stream(
+    arrivals: Iterable[TraceRequest],
+    submit: Callable[[TraceRequest], None],
+    tick: Callable[[], None],
+    is_idle: Callable[[], bool],
+    *,
+    tick_s: float = 1.0,
+    max_steps: int = 10_000_000,
+) -> int:
+    """``replay_trace`` over an arrival ITERATOR — one-request
+    lookahead, O(1) memory, for soaks whose trace never fits a list.
+
+    Requires arrivals in non-decreasing ``t`` order (``iter_trace``
+    yields strictly increasing times by construction). Same step-domain
+    semantics as ``replay_trace``: tick ``k`` submits everything with
+    ``t <= k * tick_s``, then ticks once; after the stream is drained
+    it ticks until ``is_idle()``. Returns the number of ticks run.
+    """
+    if tick_s <= 0:
+        raise ValueError("tick_s must be positive")
+    it = iter(arrivals)
+    pending = next(it, None)
+    last_t = float("-inf")
+    for step in range(max_steps):
+        while pending is not None and pending.t <= step * tick_s:
+            if pending.t < last_t:
+                raise ValueError(
+                    f"replay_stream needs time-ordered arrivals "
+                    f"(t={pending.t} after t={last_t})")
+            last_t = pending.t
+            submit(pending)
+            pending = next(it, None)
+        if pending is None and is_idle():
+            return step
+        tick()
+    raise RuntimeError(
+        f"stream replay did not converge within {max_steps} ticks")

@@ -251,6 +251,22 @@ class BlockAllocator:
         if self.on_transition is not None:
             self.on_transition(event, owner, info)
 
+    def census_decls(self):
+        from pytorch_distributed_tpu.telemetry.census import Decl
+
+        return [
+            Decl("_free", "fixed", cap=lambda a: a.n_blocks - 1,
+                 why="free list over the fixed pool (block 0 is TRASH)"),
+            Decl("_chains", "fixed", cap=lambda a: a.n_blocks - 1,
+                 why="one chain per owner, every chain holds ≥ 1 block "
+                     "of the fixed pool"),
+            Decl("_refs", "fixed", cap=lambda a: a.n_blocks - 1,
+                 why="refcount per allocated block of the fixed pool"),
+            Decl("_states", "fixed", cap=lambda a: a.n_blocks - 1,
+                 why="swap state per owner-with-chain (subset of "
+                     "_chains); entries cleared on free/clear_state"),
+        ]
+
     @property
     def available(self) -> int:
         return len(self._free)
@@ -592,6 +608,20 @@ class PrefixIndex:
         """Indexed blocks (== index-held references)."""
         return self._nodes
 
+    def census_decls(self):
+        from pytorch_distributed_tpu.telemetry.census import Decl
+
+        return [
+            Decl(".", "fixed", cap=lambda ix: ix.allocator.n_blocks - 1,
+                 why="every node holds an incref on a distinct live pool "
+                     "block, so the radix tree cannot outgrow the pool — "
+                     "the LRU evict path is how it shrinks under "
+                     "pressure (the round-21 *proven* bound)"),
+            Decl("_children", "fixed",
+                 cap=lambda ix: ix.allocator.n_blocks - 1,
+                 why="root edges are a subset of nodes"),
+        ]
+
     @staticmethod
     def _key(tokens, start: int, stop: int) -> tuple:
         return tuple(int(t) for t in tokens[start:stop])
@@ -802,3 +832,13 @@ class HostBlockStore:
     def rids(self) -> List[int]:
         with self._lock:
             return sorted(self._chains)
+
+    def census_decls(self):
+        from pytorch_distributed_tpu.telemetry.census import Decl
+
+        return [
+            Decl("_chains", "live",
+                 why="one host copy per PARKED request (a strict subset "
+                     "of live requests); put() additionally refuses past "
+                     "max_bytes when a byte budget is set"),
+        ]

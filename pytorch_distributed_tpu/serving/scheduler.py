@@ -117,7 +117,7 @@ import dataclasses
 import threading
 import time
 from collections import deque
-from typing import Dict, List, NamedTuple, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -476,6 +476,12 @@ class Scheduler:
             self.sentinel.detector("tick_time").abs_floor = 0.01
             self.sentinel.detector("ttft").abs_floor = 0.01
             self.sentinel.detector("queue_depth").abs_floor = 1.0
+        # round 21 (scale observatory): optional retire hook,
+        # ``on_retire(rid, outcome)``, fired on the main thread when a
+        # request leaves the scheduler for good (complete / cancel /
+        # deadline). The fleet router uses it to drop per-rid
+        # bookkeeping in streaming-retention mode.
+        self.on_retire: Optional[Callable[[int, str], None]] = None
 
     # ---- API ----
 
@@ -1377,6 +1383,8 @@ class Scheduler:
                         preempts=req.preempts or None,
                     )
                 self._log_request(req)
+                if self.on_retire is not None:
+                    self.on_retire(req.rid, "complete")
             else:
                 self.remaining[slot] -= 1
         if out:
@@ -1727,6 +1735,8 @@ class Scheduler:
                 self.reqtrace.root(req.rid), outcome=outcome,
                 new_tokens=req.produced, reason=reason,
             )
+        if self.on_retire is not None:
+            self.on_retire(req.rid, outcome)
 
     # ---- replica death: harvest + abandon (fleet failure plane) ----
 
@@ -1977,6 +1987,78 @@ class Scheduler:
             and self._step_count - self._last_anomaly_step
             <= self.anomaly_recent_ticks
         )
+
+    def live_requests(self) -> int:
+        """In-flight requests this replica owns right now — queued,
+        resident (prefill/decode/handoff-ready), parked, mid-swap-out.
+        The census sweep's O(live) audit axis (round 21)."""
+        return (len(self.queue) + len(self.resident) + len(self.parked)
+                + len(self._swapping))
+
+    def census_decls(self):
+        """Bound declarations for every long-lived container on this
+        scheduler (round 21 scale observatory; telemetry/census.py).
+        The meta-test in tests/test_scale_obs.py fails if a container
+        attr exists without a declaration — new per-request state must
+        say how it is bounded."""
+        from pytorch_distributed_tpu.telemetry.census import Decl
+
+        return [
+            Decl("queue", "live",
+                 why="admission backlog; bounded by the SLO gate's "
+                     "shed/backpressure ladder in a fleet, by the "
+                     "caller's submit rate standalone"),
+            Decl("resident", "fixed", cap=lambda s: s.n_slots,
+                 why="slot-keyed; admission only fills free slots"),
+            Decl("parked", "live",
+                 why="preempted requests awaiting restore — a subset of "
+                     "live requests; host_store byte budget bounds it "
+                     "again from below"),
+            Decl("_swapping", "fixed", cap=lambda s: s.n_slots,
+                 why="open d2h windows; each holds a distinct slot"),
+            Decl("_swap_slots", "fixed", cap=lambda s: s.n_slots,
+                 why="slots mid-swap-out; subset of all slots"),
+            Decl("ready", "fixed", cap=lambda s: s.n_slots,
+                 why="handoff-ready rids each pin a slot HERE until "
+                     "complete_handoff frees it"),
+            Decl("_slot2rid", "fixed", cap=lambda s: s.n_slots,
+                 why="slot-keyed reverse map; entries overwritten on "
+                     "slot reuse, popped on free (audit candidate from "
+                     "ISSUE 19 — proven slot-bounded, not rid-bounded)"),
+            Decl("_collected", "fixed", cap=lambda s: 4 * s.n_slots,
+                 why="early-collected tokens awaiting the next "
+                     "collect_tick; at most a couple of ticks' worth "
+                     "(≤ n_slots tokens each) can stash between drains"),
+            Decl("_tick_obs", "fixed", cap=lambda s: 2 * s.tick_obs_batch,
+                 why="sentinel feed batch, flushed every tick_obs_batch "
+                     "observations"),
+            Decl("_gate_cache", "fixed", cap=64,
+                 why="one snapshot dict of gate percentile keys, "
+                     "replaced wholesale each refresh"),
+            # dotted reaches: bounded children whose containers would
+            # otherwise escape the sweep
+            Decl("ttft.values", "fixed", cap=lambda s: 2 * s.ttft.window,
+                 why="LatencySeries percentile window (round 21 cap)"),
+            Decl("ttft_warm.values", "fixed",
+                 cap=lambda s: 2 * s.ttft_warm.window,
+                 why="LatencySeries percentile window"),
+            Decl("token_lat.values", "fixed",
+                 cap=lambda s: 2 * s.token_lat.window,
+                 why="LatencySeries percentile window"),
+            Decl("queue_wait.values", "fixed",
+                 cap=lambda s: 2 * s.queue_wait.window,
+                 why="LatencySeries percentile window"),
+            Decl("tick_lat.values", "fixed",
+                 cap=lambda s: 2 * s.tick_lat.window,
+                 why="LatencySeries percentile window"),
+            Decl("swap_lat.values", "fixed",
+                 cap=lambda s: 2 * s.swap_lat.window,
+                 why="LatencySeries percentile window"),
+            Decl("prog_times._acc", "fixed", cap=256,
+                 why="per-program aggregates (closed program set)"),
+            Decl("host_store._chains", "live",
+                 why="one host copy per parked request"),
+        ]
 
     def metrics(self) -> dict:
         """Exact host-side accounting; all counters, no device sync."""

@@ -66,6 +66,22 @@ overlap"):
   ``scripts/bench_serving.py --wall-clock`` is the fleet bench ROADMAP
   item 3's async refactor gates against).
 
+Round 21 adds the scale observatory (ANALYSIS.md "Scale observatory"):
+
+- ``hostprof`` — a ``ResourceMonitor`` sampling host RSS
+  (``/proc/self/status``, ``getrusage`` fallback), gc population, and
+  optional tracemalloc top sites on a tick-count cadence
+  (``kind="resource"`` JSONL);
+- ``census`` — the bounded-structure census: every long-lived
+  container on the swept serving classes declares its bound class
+  (fixed / O(live) / O(replicas) / unbounded-by-design) and a sweep
+  audits actual ``len()`` against it (``kind="census"``; an undeclared
+  container is itself a finding);
+- ``scaling`` — a growth sentinel regressing RSS, per-tick host wall,
+  and structure sizes against session counts with MAD-floored
+  flagging, so "flat host cost at 100k sessions" is a checked verdict
+  (``bench_serving.py --soak``), not an impression.
+
 Everything reports through the one JSONL schema of
 ``utils.profiling.MetricsLogger``; ``scripts/telemetry_report.py``
 renders a run's JSONL into the summary table ``bench.py`` consumes.
@@ -75,6 +91,12 @@ ANALYSIS.md "Observability & goodput" documents the schema.
 from pytorch_distributed_tpu.telemetry.anomaly import (
     AnomalySentinel,
     StreamingDetector,
+)
+from pytorch_distributed_tpu.telemetry.census import (
+    Decl,
+    StructCensus,
+    audit_owner,
+    undeclared_containers,
 )
 from pytorch_distributed_tpu.telemetry.costmodel import (
     CostCard,
@@ -99,6 +121,11 @@ from pytorch_distributed_tpu.telemetry.goodput import (
     GOODPUT_CATEGORIES,
     GoodputLedger,
 )
+from pytorch_distributed_tpu.telemetry.hostprof import (
+    NULL_MONITOR,
+    ResourceMonitor,
+    rss_mib,
+)
 from pytorch_distributed_tpu.telemetry.latency import LatencySeries, percentiles
 from pytorch_distributed_tpu.telemetry.overlap import (
     NULL_LEDGER,
@@ -121,6 +148,11 @@ from pytorch_distributed_tpu.telemetry.reqtrace import (
     trace_rids,
     validate_trace,
 )
+from pytorch_distributed_tpu.telemetry.scaling import (
+    GrowthSentinel,
+    fit_growth,
+    mad_scale,
+)
 from pytorch_distributed_tpu.telemetry.schema import (
     REQUIRED_KEYS,
     validate_record,
@@ -131,6 +163,16 @@ from pytorch_distributed_tpu.telemetry.spans import NULL_TRACER, SpanTracer
 __all__ = [
     "AnomalySentinel",
     "StreamingDetector",
+    "Decl",
+    "StructCensus",
+    "audit_owner",
+    "undeclared_containers",
+    "NULL_MONITOR",
+    "ResourceMonitor",
+    "rss_mib",
+    "GrowthSentinel",
+    "fit_growth",
+    "mad_scale",
     "CostCard",
     "ProgramTimes",
     "SwapDecision",

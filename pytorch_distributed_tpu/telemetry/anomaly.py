@@ -155,3 +155,13 @@ class AnomalySentinel:
             name: det.anomalies for name, det in self._detectors.items()
             if det.anomalies
         }
+
+    def census_decls(self):
+        from .census import Decl
+
+        return [
+            Decl("_detectors", "fixed", cap=64,
+                 why="one detector per named series; call sites name a "
+                     "closed set (tick_time, ttft, queue_depth, ...) and "
+                     "each detector's window is a deque(maxlen)"),
+        ]

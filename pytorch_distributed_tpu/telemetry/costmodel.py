@@ -336,6 +336,16 @@ class ProgramTimes:
     def items(self):
         return self._acc.items()
 
+    def census_decls(self):
+        from pytorch_distributed_tpu.telemetry.census import Decl
+
+        return [
+            Decl("_acc", "fixed", cap=256,
+                 why="(calls, total_s) aggregate per program name — "
+                     "O(registered programs), not O(observations); the "
+                     "ProgramRegistry is a small closed set"),
+        ]
+
 
 def build_cost_cards(registry, times: Optional[ProgramTimes] = None,
                      ) -> List[CostCard]:

@@ -80,6 +80,15 @@ class FlightRecorder:
         with self._lock:
             return len(self._ring)
 
+    def census_decls(self):
+        from pytorch_distributed_tpu.telemetry.census import Decl
+
+        return [
+            Decl("_ring", "fixed", cap=lambda r: r._ring.maxlen,
+                 why="deque(maxlen=capacity): the bounded ring is the "
+                     "module's whole design"),
+        ]
+
     # -- dumping -----------------------------------------------------------
 
     def dump(self, path: str, reason: str) -> Optional[str]:

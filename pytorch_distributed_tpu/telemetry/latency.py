@@ -32,33 +32,67 @@ def percentiles(
 
 
 class LatencySeries:
-    """Append-only series of seconds with a flat summary.
+    """Windowed series of seconds with a cumulative flat summary.
 
     ``summary(prefix)`` → ``{prefix_count, prefix_mean_s, prefix_p50_s,
     prefix_p95_s, prefix_p99_s, prefix_max_s}`` (empty series → counts
     only), ready to merge into a metrics dict / JSONL record.
+
+    Round 21 (scale observatory): the raw buffer is capped at
+    ``window`` observations so a 100k-session soak doesn't hold every
+    latency sample ever taken — the census declares this bound.
+    ``count``/``mean_s``/``max_s`` stay *cumulative* (running count,
+    sum, and max survive the window); percentiles are over the most
+    recent ``window`` observations, which is also what an SLO gate
+    wants to react to.  ``values`` remains a plain list (consumers
+    concatenate and snapshot it) holding at most ``2 * window``
+    entries — trimming is amortized by slicing half away only when the
+    buffer doubles, keeping ``observe`` O(1) amortized.
     """
 
-    def __init__(self, name: str = ""):
+    def __init__(self, name: str = "", window: int = 4096):
         self.name = name
+        self.window = int(window)
         self.values: List[float] = []
+        self.count = 0
+        self._sum = 0.0
+        self._max = 0.0
 
     def observe(self, seconds: float) -> None:
-        self.values.append(float(seconds))
+        s = float(seconds)
+        self.values.append(s)
+        self.count += 1
+        self._sum += s
+        if s > self._max:
+            self._max = s
+        if len(self.values) >= 2 * self.window:
+            del self.values[: len(self.values) - self.window]
 
     def __len__(self) -> int:
-        return len(self.values)
+        return self.count
+
+    def window_values(self) -> List[float]:
+        return self.values[-self.window:]
+
+    def census_decls(self):
+        from .census import Decl
+
+        return [
+            Decl("values", "fixed", cap=lambda s: 2 * s.window,
+                 why="percentile window; amortized trim keeps ≤ 2·window "
+                     "entries, cumulative count/sum/max live in scalars"),
+        ]
 
     def summary(self, prefix: str = "") -> dict:
         import numpy as np
 
         p = f"{prefix}_" if prefix else ""
-        out = {f"{p}count": len(self.values)}
+        out = {f"{p}count": self.count}
         if not self.values:
             return out
-        vals = np.asarray(self.values, dtype=np.float64)
-        out[f"{p}mean_s"] = float(vals.mean())
-        out[f"{p}max_s"] = float(vals.max())
+        out[f"{p}mean_s"] = self._sum / self.count
+        out[f"{p}max_s"] = self._max
+        vals = np.asarray(self.window_values(), dtype=np.float64)
         for q, v in percentiles(vals).items():
             out[f"{p}{q}_s"] = v
         return out

@@ -475,6 +475,20 @@ class DispatchLedger:
         with self._lock:
             return list(self.records)
 
+    def census_decls(self):
+        from pytorch_distributed_tpu.telemetry.census import Decl
+
+        return [
+            Decl("records", "unbounded",
+                 why="O(launches) profiling log by design — the ledger "
+                     "is enabled only for bounded bench windows; soaks "
+                     "run NULL_LEDGER and take per-tick wall from "
+                     "hostprof.ResourceMonitor instead"),
+            Decl("_streams", "unbounded",
+                 why="per-replica launch stream mirroring ``records`` "
+                     "(same bound, same bench-window-only lifetime)"),
+        ]
+
 
 #: Shared no-op ledger (the NULL_TRACER pattern): call sites thread one
 #: through unconditionally.

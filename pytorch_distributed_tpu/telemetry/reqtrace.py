@@ -209,6 +209,15 @@ class ReqTracer:
                 "dur_s": round(now - begin["t"], 9),
             }
             rec.update(attrs)
+            # Closing the root retires the trace: drop the rid→root
+            # entry so _roots stays O(open traces), not O(rids ever)
+            # (round 21 census finding — 100k sessions held 100k ints
+            # here). A later open_root for a *harvested* rid still
+            # finds its entry because abandon() deliberately leaves
+            # dead-replica roots open; only a closed root is purged.
+            trace = begin["trace"]
+            if self._roots.get(trace) == span:
+                del self._roots[trace]
             self._emit(rec)
 
     @contextlib.contextmanager
@@ -274,6 +283,25 @@ class ReqTracer:
                 rid for rid, span in self._roots.items()
                 if span in self._open
             )
+
+    def census_decls(self):
+        from .census import Decl
+
+        return [
+            Decl("records", lambda t: "unbounded" if t.keep else "fixed",
+                 cap=lambda t: None if t.keep else 0,
+                 why="keep-mode retains every record for in-process "
+                     "assertions (tests/forensics); streaming mode "
+                     "(sink set, keep=False) holds none"),
+            Decl("_open", "live", per_live=8,
+                 why="open begin records; a live request holds at most a "
+                     "handful of concurrently-open spans (root, queue, "
+                     "prefill/decode window, swap, handoff)"),
+            Decl("_roots", "live",
+                 why="rid→root map, purged when the root closes "
+                     "(round 21); harvested rids' roots stay open by "
+                     "design until the router resolves them"),
+        ]
 
 
 #: Shared no-op tracer (the NULL_TRACER pattern): lifecycle owners thread

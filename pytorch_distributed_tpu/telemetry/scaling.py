@@ -1,0 +1,173 @@
+"""Growth sentinel — regress host cost against load, flag growth.
+
+Round 21.  A 100k-session soak produces per-sample series of RSS and
+mean per-tick host wall against live-and-cumulative session counts
+(``hostprof.ResourceMonitor``) plus per-structure sizes
+(``census.StructCensus``).  ROADMAP item 5's acceptance is that these
+stay *flat*: host cost must be O(live batch), not O(sessions ever).
+This module turns "looks flat" into a fit with a noise floor.
+
+The flagging rule reuses the PR 8 anomaly-sentinel floor idea: a
+series' natural jitter scale is ``max(1.4826·MAD, rel_floor·|median|,
+abs_floor)`` — so a constant series (MAD 0) cannot flag off numeric
+dust, and a noisy-but-flat series needs *total fitted growth across
+the observed load range* to exceed ``threshold ×`` that scale before
+it counts as growing.  Superlinearity is judged by refitting each half
+of the load range: accelerating slope (second half ≫ first half) on a
+growing series reads as superlinear — the O(N²) shape a per-tick scan
+of an O(N) structure produces.
+
+On a shared-CPU runner the *wall* series is noisy (neighbors steal the
+core); the MAD floor absorbs that, but a wall verdict here is a smoke
+alarm, not a proof — see ANALYSIS.md "Scale observatory" for what a
+slope does and does not establish.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["fit_growth", "mad_scale", "GrowthSentinel"]
+
+
+def _median(vals: Sequence[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def mad_scale(ys: Sequence[float], *, rel_floor: float = 0.05,
+              abs_floor: float = 1e-9) -> float:
+    """Robust jitter scale with the PR 8 sentinel floors applied."""
+    med = _median(ys)
+    mad = _median([abs(y - med) for y in ys])
+    return max(1.4826 * mad, rel_floor * abs(med), abs_floor)
+
+
+def _ols(xs: Sequence[float], ys: Sequence[float]) -> Tuple[float, float]:
+    n = len(xs)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    sxx = sum((x - mx) ** 2 for x in xs)
+    if sxx <= 0.0:
+        return 0.0, my
+    sxy = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    slope = sxy / sxx
+    return slope, my - slope * mx
+
+
+def fit_growth(xs: Sequence[float], ys: Sequence[float], *,
+               threshold: float = 4.0, rel_floor: float = 0.05,
+               abs_floor: float = 1e-9, min_samples: int = 8) -> dict:
+    """Fit ``y`` against load ``x``; classify flat / linear / superlinear.
+
+    Returns a dict (JSON-ready): ``slope`` (y-units per x-unit),
+    ``growth`` (fitted rise across the observed x span), ``scale``
+    (the MAD-floored jitter scale), ``grows`` (growth exceeds
+    ``threshold × scale``), ``accel`` (second-half slope over
+    first-half slope, 0 when either half is degenerate), and
+    ``verdict`` in {"insufficient", "flat", "linear", "superlinear"}.
+    """
+    n = min(len(xs), len(ys))
+    xs, ys = list(xs[:n]), list(ys[:n])
+    out = {"n": n, "slope": 0.0, "intercept": 0.0, "growth": 0.0,
+           "scale": 0.0, "grows": False, "accel": 0.0,
+           "verdict": "insufficient"}
+    if n < min_samples:
+        return out
+    span = max(xs) - min(xs)
+    if span <= 0:
+        return out
+    slope, intercept = _ols(xs, ys)
+    # Jitter scale from the fit RESIDUALS — the raw series' MAD
+    # contains the trend itself and would mask exactly the growth we
+    # hunt; the floors still ride on the series' own level so a
+    # constant series (zero residual) cannot flag numeric dust.
+    resid = [y - (intercept + slope * x) for x, y in zip(xs, ys)]
+    scale = max(mad_scale(resid, rel_floor=0.0, abs_floor=abs_floor),
+                rel_floor * abs(_median(ys)), abs_floor)
+    growth = slope * span
+    grows = growth > threshold * scale
+    # Half-range refits for acceleration. Split at the median x so
+    # both halves carry data even under bursty sampling.
+    pivot = _median(xs)
+    lo = [(x, y) for x, y in zip(xs, ys) if x <= pivot]
+    hi = [(x, y) for x, y in zip(xs, ys) if x > pivot]
+    accel = 0.0
+    s_lo = s_hi = 0.0
+    if len(lo) >= max(2, min_samples // 2) and len(hi) >= max(
+            2, min_samples // 2):
+        s_lo, _ = _ols([p[0] for p in lo], [p[1] for p in lo])
+        s_hi, _ = _ols([p[0] for p in hi], [p[1] for p in hi])
+        floor = scale / max(span, 1e-12)
+        if abs(s_lo) > floor:
+            accel = s_hi / s_lo
+    superlinear = bool(grows and s_hi > 0 and (
+        accel > 2.0 or (s_lo <= 0 < s_hi and s_hi * span > threshold * scale)))
+    verdict = ("superlinear" if superlinear
+               else "linear" if grows else "flat")
+    out.update(slope=slope, intercept=intercept, growth=growth,
+               scale=scale, grows=bool(grows), accel=round(accel, 3),
+               verdict=verdict)
+    return out
+
+
+class GrowthSentinel:
+    """Named (load, value) series + end-of-run growth verdicts.
+
+    ``observe(name, x, y)`` appends one point (ring-bounded);
+    ``report()`` fits every series; ``flags()`` lists the series whose
+    verdict is linear/superlinear.  Structure-size series from the
+    census and resource series from the monitor share one sentinel so
+    the soak summary has a single "what grew" answer.
+    """
+
+    def __init__(self, *, window: int = 4096, threshold: float = 4.0,
+                 rel_floor: float = 0.05, abs_floor: float = 1e-9,
+                 min_samples: int = 8):
+        self.window = int(window)
+        self.threshold = threshold
+        self.rel_floor = rel_floor
+        self.abs_floor = abs_floor
+        self.min_samples = min_samples
+        self._series: Dict[str, deque] = {}
+
+    def census_decls(self):
+        from .census import Decl
+
+        return [
+            Decl("_series", "fixed", cap=256,
+                 why="one ring per named series; call sites name a closed "
+                     "set (rss, tick_wall, census structures)"),
+        ]
+
+    def observe(self, name: str, x: float, y: Optional[float]) -> None:
+        if y is None:
+            return
+        buf = self._series.get(name)
+        if buf is None:
+            buf = self._series[name] = deque(maxlen=self.window)
+        buf.append((float(x), float(y)))
+
+    def observe_sizes(self, x: float, sizes: Dict[str, int]) -> None:
+        """Feed one census sweep's structure sizes at load ``x``."""
+        for name, size in sizes.items():
+            self.observe(f"size:{name}", x, float(size))
+
+    def report(self) -> Dict[str, dict]:
+        out = {}
+        for name, buf in sorted(self._series.items()):
+            xs = [p[0] for p in buf]
+            ys = [p[1] for p in buf]
+            out[name] = fit_growth(
+                xs, ys, threshold=self.threshold, rel_floor=self.rel_floor,
+                abs_floor=self.abs_floor, min_samples=self.min_samples)
+        return out
+
+    def flags(self) -> List[str]:
+        return [name for name, fit in self.report().items()
+                if fit["verdict"] in ("linear", "superlinear")]

@@ -70,6 +70,14 @@ REQUIRED_KEYS: Dict[str, FrozenSet[str]] = {
     # fleet/router.py replica health transitions (round 19): one record
     # per state-machine edge (healthy/suspect/dead/draining/rejoining)
     "health": frozenset({"replica_id", "state", "prev", "reason", "tick"}),
+    # telemetry/hostprof.py host-resource samples (round 21): RSS in MiB
+    # plus the load axes the growth sentinel regresses against;
+    # gc/tracemalloc/tick-wall fields are optional extras
+    "resource": frozenset({"rss_mib", "rss_source", "live", "cumulative"}),
+    # telemetry/census.py bounded-structure sweeps (round 21): per-sweep
+    # verdict + per-structure sizes; violation_details/undeclared carry
+    # the loud-finding payloads
+    "census": frozenset({"ok", "violations", "structures", "worst_ratio"}),
 }
 
 #: additional required keys per span ``ev`` (see reqtrace module docs)

@@ -254,7 +254,20 @@ class MetricsLogger:
         self._lock = threading.Lock()
         if path and (not rank0_only or self._is_rank0()):
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            # a SIGKILL can leave a torn final line with no newline;
+            # seal it before appending so the NEXT record stays
+            # parseable (readers skip the torn fragment as one bad
+            # line instead of losing two records merged into it)
+            torn = False
+            try:
+                with open(path, "rb") as existing:
+                    existing.seek(-1, os.SEEK_END)
+                    torn = existing.read(1) != b"\n"
+            except OSError:
+                pass  # missing or empty file: nothing to seal
             self._f = open(path, "a", buffering=1)
+            if torn:
+                self._f.write("\n")
             atexit.register(self.close)
 
     @staticmethod

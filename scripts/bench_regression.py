@@ -71,6 +71,13 @@ BAND_OVERRIDES: Tuple[Tuple[str, float], ...] = (
     # magnitudes are additionally backend-marked as not-a-claim
     # (PERF_NOTES §11)
     (r"^serving_wallclock_", 1.5),
+    # round-21 soak keys: the growth SLOPES are the claim (down is
+    # good; direction overrides below), but their magnitudes ride the
+    # same shared-box weather as the wall-clock bench — a slope near
+    # zero makes relative bands twitchy, so the band is wide and the
+    # census/verdict gates (strings + ci_check --soak-smoke) carry the
+    # hard pass/fail instead
+    (r"^serving_soak_", 1.5),
     # round-20 kernel-variant columns (fp8 / split-S / tuned): decode
     # tok/s over a tiny model is scheduler-noise-dominated even on TPU;
     # the ratios move with it. On a CPU backend these rows are skipped
@@ -135,6 +142,13 @@ DIRECTION_OVERRIDES: Tuple[Tuple[str, str], ...] = (
     # regress DOWN when the variant loses ground; plain tok/s and p95
     # fall through to the suffix patterns (_tok_s up, _ms down)
     (r"serving_kernel_.*_over_", "up"),
+    # round-21 soak slopes: MiB (or ms) per 10k sessions — growth is
+    # the regression, shrinking is the win; RSS final rides along.
+    # Verdict/census keys are strings (auto-skipped) and *_frac keys
+    # hit the skip list — the soak-smoke gate enforces those exactly.
+    (r"serving_soak_rss_slope", "down"),
+    (r"serving_soak_host_wall_slope", "down"),
+    (r"serving_soak_rss_mib", "down"),
 )
 
 

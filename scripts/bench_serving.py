@@ -28,6 +28,10 @@ Usage: python scripts/bench_serving.py [--slots 32]
            --ab-ticks 32 --ab-prompt-len 64]  # pallas-vs-dense + int8 capacity
        python scripts/bench_serving.py --pressure [--pressure-sessions 100000
            --pressure-blocks 13 --pressure-duration 90]  # preempt vs shed-only
+       python scripts/bench_serving.py --soak [--soak-requests 100000
+           --soak-log soak.jsonl --soak-slots 8 --soak-replicas 2]
+           # round 21 scale observatory: stream >=100k unique-session
+           # requests, census + RSS/host-wall growth fits (serving_soak_*)
 
 Round 15 (overlap profiler): ``--wall-clock`` is the ROADMAP-item-3
 fleet bench — ONE trace served saturated (no nominal tick) by 1 replica
@@ -1327,6 +1331,190 @@ def measure_wallclock(trace=None, n_replicas: int = 2, slots: int = 4,
     return out
 
 
+# ---------------------------------------------------------------------------
+# scale observatory soak (round 21): the ROADMAP-item-5 100k-session run
+# ---------------------------------------------------------------------------
+
+
+def measure_soak(requests: int = 100_000, out_path: str | None = None,
+                 seed: int = 0, slots: int = 8, replicas: int = 2,
+                 every_ticks: int | None = None,
+                 log_max_bytes: int = 4 << 20) -> dict:
+    """The scale-observatory soak (ISSUE 19 / ROADMAP item 5): stream a
+    ``requests``-session heavy-tail trace — every request its OWN
+    session id, the million-user shape that stresses the affinity LRU
+    hardest — through a ``replicas``-replica fleet, and prove host cost
+    O(live batch), not O(sessions ever):
+
+    - the trace is NEVER materialized (``iter_trace``/``replay_stream``,
+      one-request lookahead) and the router runs streaming retention
+      (``retain_results=False``), so the harness itself is O(live);
+    - ``ResourceMonitor`` samples RSS + mean per-tick host wall on a
+      tick-count cadence into the rotating MetricsLogger JSONL
+      (rotation is exercised — the per-request records alone overflow
+      ``log_max_bytes`` many times over);
+    - ``StructCensus`` sweeps every declared container in the fleet on
+      the same cadence (undeclared containers or bound violations fail
+      the run's verdict);
+    - ``GrowthSentinel``/``fit_growth`` regress RSS and per-tick wall
+      against cumulative sessions; slopes are quoted per 10k sessions.
+
+    HONESTY (``serving_soak_backend``): on the shared-CPU runner the
+    wall slope is a smoke alarm (neighbors steal the core; the MAD
+    floor absorbs it), while the RSS slope and the census verdict are
+    real host-memory claims on any backend — see ANALYSIS.md "Scale
+    observatory". Profiling that is O(launches) stays OFF (no dispatch
+    ledger, no reqtrace): per-tick wall comes from the monitor.
+    """
+    import tempfile
+
+    from pytorch_distributed_tpu.fleet import (
+        FleetRouter,
+        iter_trace,
+        prompt_for,
+        replay_stream,
+    )
+    from pytorch_distributed_tpu.telemetry import (
+        GrowthSentinel,
+        ResourceMonitor,
+        StructCensus,
+        rss_mib,
+        undeclared_containers,
+    )
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    cfg, params = _tiny_model()
+    # Sample cadence: ~256 ticks at soak scale, scaled down for smokes
+    # so short runs still give the fits >= min_samples points.
+    if every_ticks is None:
+        every_ticks = max(8, min(256, requests // 32))
+    tmp = None
+    if out_path is None:
+        tmp = tempfile.TemporaryDirectory()
+        out_path = os.path.join(tmp.name, "soak.jsonl")
+    mlog = MetricsLogger(out_path, max_bytes=log_max_bytes)
+    router = FleetRouter(
+        cfg, params, n_replicas=replicas, seed=seed, metrics_log=mlog,
+        n_slots=slots, block_len=16, prefill_chunk=32, admit_per_step=8,
+        retain_results=False, prefix_cache=True,
+    )
+    router.warmup()
+    census = StructCensus(mlog)
+    census.register_many(router.census_owners())
+    monitor = ResourceMonitor(mlog, every_ticks=every_ticks,
+                              gc_objects=True, tracemalloc_every=32,
+                              top_sites=5)
+    census.register("monitor", monitor)
+    sentinel = GrowthSentinel()
+    census.register("sentinel", sentinel)
+    undeclared_at_start = sorted(
+        u for name, obj in census.owners()
+        for u in undeclared_containers(obj))
+    rss0, rss_src = rss_mib()
+
+    submitted = [0]
+    peak_live = [0]
+    worst = [0.0, ""]  # max worst_ratio across sweeps + its structure
+
+    def submit(r):
+        router.submit(prompt_for(r, cfg.vocab_size), r.max_new,
+                      session=r.session)
+        submitted[0] += 1
+
+    def tick():
+        t0 = time.perf_counter()
+        router.step()
+        dt = time.perf_counter() - t0
+        live = router.live_requests()
+        if live > peak_live[0]:
+            peak_live[0] = live
+        rec = monitor.tick(live=live, cumulative=submitted[0], wall_s=dt)
+        if rec is not None:
+            sweep = census.sweep(live=live, replicas=replicas,
+                                 tick=monitor.ticks, live_slack=4 * slots)
+            # The observatory's own rings (monitor history, sentinel
+            # series) grow by construction until their caps fill; the
+            # census audits those caps. Size-growth flags are for the
+            # FLEET's structures.
+            sentinel.observe_sizes(submitted[0], {
+                k: v for k, v in sweep["structures"].items()
+                if not k.startswith(("monitor.", "sentinel."))})
+            if sweep["worst_ratio"] > worst[0]:
+                worst[0], worst[1] = sweep["worst_ratio"], sweep["worst_name"]
+
+    # Offered load ~1.6 req/tick against ~2.3 req/tick of fleet service
+    # capacity (ceil(prompt/chunk) + max_new slot-ticks per request):
+    # heavily loaded, never divergent. duration_s is an over-generous
+    # horizon; islice ends the stream at exactly ``requests``.
+    import itertools
+
+    arrivals = itertools.islice(
+        iter_trace(seed=seed, duration_s=1e12, base_rate=2.0,
+                   burst_rate_mult=4.0, burst_every_s=40.0,
+                   burst_len_s=6.0, prompt_median=16, prompt_max=64,
+                   max_new_median=6, max_new_max=12,
+                   unique_sessions=True),
+        requests,
+    )
+    t_start = time.perf_counter()
+    ticks = replay_stream(arrivals, submit, tick,
+                          lambda: router.idle, tick_s=0.6)
+    wall = time.perf_counter() - t_start
+    final = monitor.sample(live=router.live_requests(),
+                           cumulative=submitted[0])
+    census.sweep(live=router.live_requests(), replicas=replicas,
+                 tick=monitor.ticks, live_slack=4 * slots)
+    m = router.metrics()
+    mlog.close()
+    monitor.close()
+
+    # Growth fits against cumulative sessions. RSS gets a tight relative
+    # floor (0.5% of the level — the jax runtime's ~1 GiB baseline would
+    # otherwise hide tens of MiB of leak behind the default 5%); the
+    # shared-CPU wall series keeps the default.
+    from pytorch_distributed_tpu.telemetry import fit_growth
+
+    rss_fit = fit_growth(*monitor.rss_series(), rel_floor=0.005,
+                         abs_floor=1.0)
+    wall_fit = fit_growth(*monitor.wall_series(), abs_floor=0.05)
+    out = {
+        "serving_soak_backend": jax.default_backend(),
+        "serving_soak_sessions": submitted[0],
+        "serving_soak_completed": m["completed"],
+        "serving_soak_shed": m["shed"],
+        "serving_soak_ticks": ticks,
+        "serving_soak_wall_s": round(wall, 1),
+        "serving_soak_rss_source": rss_src,
+        "serving_soak_rss_mib_start": round(rss0, 1),
+        "serving_soak_rss_mib_final": round(final["rss_mib"], 1),
+        "serving_soak_rss_slope_mib_per_10k": round(
+            rss_fit["slope"] * 1e4, 3),
+        "serving_soak_rss_verdict": rss_fit["verdict"],
+        "serving_soak_host_wall_slope_ms_per_10k": round(
+            wall_fit["slope"] * 1e4, 4),
+        "serving_soak_host_wall_verdict": wall_fit["verdict"],
+        "serving_soak_census_sweeps": census.sweeps,
+        "serving_soak_census_violations": census.total_violations,
+        "serving_soak_census_undeclared": census.total_undeclared,
+        "serving_soak_census_verdict": census.verdict(),
+        "serving_soak_census_worst_frac": round(worst[0], 4),
+        "serving_soak_census_worst_name": worst[1],
+        "serving_soak_undeclared_at_start": len(undeclared_at_start),
+        "serving_soak_size_flags": ",".join(
+            f for f in sentinel.flags()) or "none",
+        "serving_soak_peak_live": peak_live[0],
+        "serving_soak_results_dropped": m["results_dropped"],
+        "serving_soak_rotations": mlog.rotations,
+        "serving_soak_tokens_out": m["tokens_out"],
+        "serving_soak_tokens_per_s": round(
+            m["tokens_out"] / max(wall, 1e-9), 1),
+        "device": str(jax.devices()[0]),
+    }
+    if tmp is not None:
+        tmp.cleanup()
+    return out
+
+
 def link_probe(mb: int = 16, reps: int = 5) -> dict:
     """Same-run bandwidth/link probe, co-quoted with every serving bench
     row (ISSUE 8, ADVICE §6 — the ckpt bench's same-minute disk-probe
@@ -1445,6 +1633,16 @@ def main() -> None:
             prefix_len=_argval("--prefix-len", 64, int),
             replicas=_argval("--prefix-replicas", 2, int),
             out_path=_argval("--prefix-out", None, str),
+        ), **probe}))
+        return
+    if "--soak" in sys.argv:
+        print(json.dumps({**measure_soak(
+            requests=_argval("--soak-requests", 100_000, int),
+            out_path=_argval("--soak-log", None, str),
+            slots=_argval("--soak-slots", 8, int),
+            replicas=_argval("--soak-replicas", 2, int),
+            every_ticks=_argval("--soak-every", None, int),
+            log_max_bytes=int(_argval("--soak-log-mb", 4.0) * 2**20),
         ), **probe}))
         return
     if "--pressure" in sys.argv:

@@ -13,7 +13,7 @@
 #                             --overlap-smoke|--async-smoke|
 #                             --prefix-smoke|--blocksan-smoke|
 #                             --chaos-smoke|--tune-smoke|
-#                             --bench-regression]
+#                             --soak-smoke|--bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -139,6 +139,17 @@
 # failure-plane counters. The fast chaos grid itself rides tier-1
 # (tests/test_chaos_matrix.py, non-@slow); the full fault×state grid is
 # @slow (~30 s).
+#
+# --soak-smoke: lint, then the round-21 scale-observatory cycle in
+# miniature: ~2k heavy-tail sessions streamed through the 2-replica
+# fleet with retention off (bench_serving.py --soak), the host-resource
+# monitor + structure census + growth sentinel armed, and the metrics
+# log capped small enough to force a rotation — the run must finish
+# with the census verdict ok (zero bound violations, zero undeclared
+# containers), a non-growing RSS verdict, and telemetry_report.py must
+# render the resource AND census sections from the rotated JSONL alone
+# (--require resource,census). The 100k-session run this miniaturizes
+# is the @slow soak + the BENCH_r09 row (~60 s).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -547,6 +558,45 @@ print(f"telemetry: {len(health)} health transitions on the wire, "
       f"fleet_summary carries the failure plane")
 PY
     echo "chaos smoke OK"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--soak-smoke" ]]; then
+    echo "== soak smoke (2k-session stream -> census ok, flat RSS, report) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    # small log cap so the rotation path is exercised, not just present
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --soak \
+        --soak-requests 2000 --soak-log "$smoke/soak.jsonl" \
+        --soak-log-mb 0.25 > "$smoke/soak.json"
+    python - "$smoke/soak.json" "$smoke/soak.jsonl" <<'PY'
+import json, os, sys
+row = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert row["serving_soak_sessions"] == 2000, row["serving_soak_sessions"]
+assert row["serving_soak_census_verdict"] == "ok", row
+assert row["serving_soak_census_violations"] == 0, row
+assert row["serving_soak_census_undeclared"] == 0, row
+assert row["serving_soak_undeclared_at_start"] == 0, row
+# 2k sessions is far too short for a slope claim; the gate is only
+# that the sentinel did not see runaway growth at this scale
+assert row["serving_soak_rss_verdict"] in ("flat", "linear", "insufficient"), row
+assert row["serving_soak_rss_slope_mib_per_10k"] < 50.0, row
+assert row["serving_soak_results_dropped"] > 0, \
+    "streaming retention kept results — soak would accumulate them"
+assert row["serving_soak_rotations"] >= 1, \
+    "log cap never rotated — rotation path untested"
+assert os.path.exists(sys.argv[2] + ".1"), "rotated mirror missing"
+print(f"soak smoke: {row['serving_soak_completed']} completed / "
+      f"{row['serving_soak_shed']} shed over {row['serving_soak_ticks']} "
+      f"ticks, census ok ({row['serving_soak_census_sweeps']} sweeps, "
+      f"worst bound {row['serving_soak_census_worst_frac']:.0%}), "
+      f"rss {row['serving_soak_rss_mib_final']:.0f} MiB "
+      f"({row['serving_soak_rss_verdict']}), "
+      f"{row['serving_soak_rotations']} log rotation(s)")
+PY
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/soak.jsonl" --json --require resource,census > /dev/null
+    echo "soak smoke OK"
     exit 0
 fi
 

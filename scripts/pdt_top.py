@@ -18,7 +18,11 @@ fleet's shared stream) and re-render an aggregate view every
   the lifecycle span stream — roots begun but not yet ended;
 - **pressure** (round 14): preempt count/rate and decision mix, parked
   chains from the newest ``fleet_summary``, swap bytes moved and
-  aborts, from ``kind="preempt"``/``kind="swap"`` records.
+  aborts, from ``kind="preempt"``/``kind="swap"`` records;
+- **resource** (round 21): newest RSS and its live slope against
+  cumulative sessions (``kind="resource"`` monitor samples), plus the
+  newest census sweep's verdict and worst bound ratio
+  (``kind="census"``) — the scale observatory's in-flight view.
 
 Only new bytes are read per refresh (the files are followed, not
 re-parsed), so tailing a long run is O(new events). ``--once`` renders
@@ -113,6 +117,11 @@ class View:
         self.open_spans: set = set()
         self.open_roots: set = set()
         self.span_records = 0
+        # host resources (round 21; kind="resource"/"census"): tail
+        # window of monitor samples for the live RSS slope, plus the
+        # newest census sweep's verdict
+        self.resources: List[dict] = []
+        self.census_violations = 0
         # host–device overlap (round 15; kind="overlap"): newest summary
         # per replica plus a rolling tail of bubbles — busy % and the
         # top recent bubble cause per replica
@@ -161,6 +170,12 @@ class View:
                 self.recent_prefix.append(r)
                 if len(self.recent_prefix) > self.window:
                     self.recent_prefix.pop(0)
+            elif kind == "resource":
+                self.resources.append(r)
+                if len(self.resources) > self.window:
+                    self.resources.pop(0)
+            elif kind == "census":
+                self.census_violations += r.get("violations", 0)
             elif kind == "overlap":
                 ev = r.get("ev")
                 if ev == "launch":
@@ -300,6 +315,36 @@ class View:
                 f"overlap  {self.overlap_launches} launches  "
                 + "  ".join(cells)
             )
+        if self.resources:
+            # live host-resource row (round 21): newest RSS + the slope
+            # over the tailed window, regressed against cumulative
+            # sessions — the in-flight view of the soak's headline fit
+            from pytorch_distributed_tpu.telemetry.scaling import (
+                fit_growth,
+            )
+
+            newest = self.resources[-1]
+            line = (f"resource rss {newest.get('rss_mib', 0.0):.0f} MiB "
+                    f"({newest.get('rss_source', '?')})  "
+                    f"live {newest.get('live', 0)} / "
+                    f"{newest.get('cumulative', 0)} sessions")
+            fit = fit_growth(
+                [r.get("cumulative", 0) for r in self.resources],
+                [r.get("rss_mib", 0.0) for r in self.resources],
+                rel_floor=0.005, abs_floor=1.0)
+            if fit["verdict"] != "insufficient":
+                line += (f"  slope {fit['slope'] * 1e4:+.1f} MiB/10k "
+                         f"({fit['verdict']})")
+            census = self.last.get("census")
+            if census:
+                worst = census.get("worst_ratio", 0.0)
+                line += (f"  census "
+                         + ("ok" if census.get("ok") else "NOT-OK")
+                         + (f" worst {census.get('worst_name', '')}"
+                            f"={worst:.2f}" if worst else ""))
+                if self.census_violations:
+                    line += f"  violations={self.census_violations}"
+            out.append(line)
         fs = self.last.get("fleet_summary")
         if fs:
             reps = fs.get("replicas", 0)

@@ -40,6 +40,14 @@ produces, from the JSONL alone:
   (other-replica-tick / tokenize / admission / JSONL / handoff / swap /
   idle), and dispatch-to-completion p50/p95 per program, from
   ``kind="overlap"`` dispatch-ledger records;
+- the **host-resource section** (round 21; ``telemetry/hostprof.py``)
+  — RSS and per-tick host-wall growth fits against cumulative sessions
+  (slopes per 10k, flat/linear/superlinear verdicts), gc population and
+  tracemalloc top sites, from ``kind="resource"`` monitor samples;
+- the **structure-census section** (round 21; ``telemetry/census.py``)
+  — sweep totals, bound violations and undeclared containers (both
+  failures), worst bound ratio, and peak structure sizes, from
+  ``kind="census"`` sweep records;
 - the **request-trace section** (round 14; ``telemetry/reqtrace.py``) —
   lifecycle trace counts, completeness (every span closed, parents
   acyclic), open spans, and phase totals from ``kind="span"`` records
@@ -617,6 +625,115 @@ def span_section(records: List[dict], out: dict) -> List[str]:
     return lines
 
 
+def resource_section(records: List[dict], out: dict) -> List[str]:
+    """Host resources (round 21; ``kind="resource"`` from
+    ``telemetry.hostprof.ResourceMonitor``): RSS and per-tick host-wall
+    growth fits against cumulative sessions — the soak's headline — plus
+    the newest gc population and tracemalloc top sites when sampled."""
+    from pytorch_distributed_tpu.telemetry.scaling import fit_growth
+
+    recs = [r for r in records if r.get("kind") == "resource"]
+    if not recs:
+        return []
+    lines = ["== host resources =="]
+    first, last = recs[0], recs[-1]
+    lines.append(
+        f"  {len(recs)} samples; rss {first.get('rss_mib', 0.0):.1f} → "
+        f"{last.get('rss_mib', 0.0):.1f} MiB "
+        f"({last.get('rss_source', '?')}); live {last.get('live', 0)}, "
+        f"cumulative {last.get('cumulative', 0)} sessions"
+    )
+    xs = [r.get("cumulative", 0) for r in recs]
+    rss_fit = fit_growth(xs, [r.get("rss_mib", 0.0) for r in recs],
+                         rel_floor=0.005, abs_floor=1.0)
+    walls = [(r.get("cumulative", 0), r["tick_wall_ms_mean"])
+             for r in recs if "tick_wall_ms_mean" in r]
+    lines.append(
+        f"  rss slope {rss_fit['slope'] * 1e4:+.2f} MiB/10k sessions "
+        f"({rss_fit['verdict']})"
+    )
+    out["resource_samples"] = len(recs)
+    out["resource_rss_mib_final"] = round(last.get("rss_mib", 0.0), 1)
+    out["resource_rss_slope_mib_per_10k"] = round(
+        rss_fit["slope"] * 1e4, 3)
+    out["resource_rss_verdict"] = rss_fit["verdict"]
+    if walls:
+        wall_fit = fit_growth([w[0] for w in walls],
+                              [w[1] for w in walls], abs_floor=0.05)
+        lines.append(
+            f"  host wall slope {wall_fit['slope'] * 1e4:+.3f} ms/10k "
+            f"sessions ({wall_fit['verdict']}; shared-CPU smoke alarm, "
+            f"not a proof — see ANALYSIS.md)"
+        )
+        out["resource_wall_slope_ms_per_10k"] = round(
+            wall_fit["slope"] * 1e4, 4)
+        out["resource_wall_verdict"] = wall_fit["verdict"]
+    if "gc_objects" in last:
+        lines.append(f"  gc objects: {last['gc_objects']}")
+        out["resource_gc_objects_final"] = last["gc_objects"]
+    sited = [r for r in recs if r.get("tracemalloc_top")]
+    if sited:
+        lines.append("  tracemalloc top sites (newest sample):")
+        for s in sited[-1]["tracemalloc_top"][:5]:
+            lines.append(
+                f"    {s.get('kib', 0.0):>10.1f} KiB  "
+                f"x{s.get('count', 0):<8} {s.get('site', '?')}"
+            )
+        out["resource_tracemalloc_samples"] = len(sited)
+    return lines
+
+
+def census_section(records: List[dict], out: dict) -> List[str]:
+    """Bounded-structure census (round 21; ``kind="census"`` from
+    ``telemetry.census.StructCensus``): sweep totals, any bound
+    violations or undeclared containers (both are failures), the worst
+    bound ratio seen, and the largest structures at their peaks."""
+    recs = [r for r in records if r.get("kind") == "census"]
+    if not recs:
+        return []
+    lines = ["== structure census =="]
+    violations = sum(r.get("violations", 0) for r in recs)
+    undeclared: set = set()
+    peaks: dict = {}
+    worst_frac, worst_name = 0.0, ""
+    for r in recs:
+        undeclared.update(r.get("undeclared", []))
+        for k, v in (r.get("structures") or {}).items():
+            if v > peaks.get(k, -1):
+                peaks[k] = v
+        if r.get("worst_ratio", 0.0) > worst_frac:
+            worst_frac = r["worst_ratio"]
+            worst_name = r.get("worst_name", "")
+    ok = not violations and not undeclared
+    lines.append(
+        f"  {len(recs)} sweeps over {len(peaks)} structures: "
+        + ("all bounds held"
+           if ok else f"{violations} VIOLATIONS, "
+                      f"{len(undeclared)} undeclared")
+    )
+    if worst_name:
+        lines.append(
+            f"  worst bound ratio {worst_frac:.2f} ({worst_name})"
+        )
+    if undeclared:
+        lines.append("  undeclared: " + ", ".join(sorted(undeclared)))
+    for r in recs:
+        for v in r.get("violation_details", [])[:5]:
+            lines.append(
+                f"  VIOLATION {v['name']}: size {v['size']} > bound "
+                f"{v['bound']} ({v['kind']})"
+            )
+    top = sorted(peaks.items(), key=lambda kv: -kv[1])[:8]
+    lines.append("  peak sizes: " + ", ".join(
+        f"{k}={v}" for k, v in top))
+    out["census_sweeps"] = len(recs)
+    out["census_violations"] = violations
+    out["census_undeclared"] = len(undeclared)
+    out["census_ok"] = ok
+    out["census_worst_frac"] = round(worst_frac, 4)
+    return lines
+
+
 def anomaly_section(records: List[dict], out: dict) -> List[str]:
     """Sentinel hits (``kind="anomaly"``): per-series counts and the
     latest excursions with their z-scores and baselines."""
@@ -652,12 +769,13 @@ def main(argv=None) -> int:
     p.add_argument("--require", default=None,
                    help="comma list of sections that MUST be present "
                         "(goodput, serving, warmup, fleet, pressure, "
-                        "prefix, overlap, spans, cost, anomaly) — exit "
-                        "non-zero otherwise; the ci_check.sh "
-                        "--telemetry-smoke, --warmup-smoke, "
-                        "--fleet-smoke, --obs-smoke, --pressure-smoke, "
-                        "--trace-smoke, --overlap-smoke and "
-                        "--prefix-smoke gates")
+                        "prefix, overlap, spans, cost, resource, "
+                        "census, anomaly) — exit non-zero otherwise; "
+                        "the ci_check.sh --telemetry-smoke, "
+                        "--warmup-smoke, --fleet-smoke, --obs-smoke, "
+                        "--pressure-smoke, --trace-smoke, "
+                        "--overlap-smoke, --prefix-smoke and "
+                        "--soak-smoke gates")
     args = p.parse_args(argv)
 
     records = load_records(args.paths)
@@ -673,6 +791,8 @@ def main(argv=None) -> int:
     lines += overlap_section(records, out)
     lines += span_section(records, out)
     lines += cost_section(records, out)
+    lines += resource_section(records, out)
+    lines += census_section(records, out)
     lines += anomaly_section(records, out)
     if not lines:
         print(f"no telemetry records in {args.paths}", file=sys.stderr)
@@ -688,6 +808,8 @@ def main(argv=None) -> int:
         "overlap": out.get("overlap_launches", 0) > 0,
         "spans": out.get("span_traces", 0) > 0,
         "cost": out.get("cost_programs", 0) > 0,
+        "resource": out.get("resource_samples", 0) > 0,
+        "census": out.get("census_sweeps", 0) > 0,
         "anomaly": out.get("anomalies", 0) > 0,
     }
     if not any(present.values()):

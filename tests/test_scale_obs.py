@@ -1,0 +1,488 @@
+"""Scale observatory (round 21): host-resource census + growth sentinel.
+
+Three claims, each with its regression teeth:
+
+1. The telemetry PIPELINE is itself memory-bounded at soak volume —
+   MetricsLogger rotation keeps disk at ~2x the cap and ``read_mirror``
+   stitches the rotated generation back in order, torn tail and all.
+2. Every long-lived container in the serving stack is DECLARED with a
+   bound class, the census meta-test fails the build when a new one
+   appears undeclared, and the bounds it declares actually hold on a
+   real fleet (the round-21 leak fixes — ``_origin`` popped at retire,
+   streaming retention, the reject-table cap — each get a regression
+   cell here).
+3. The growth sentinel's fit is honest in both directions: a noise-free
+   linear ramp must flag (the raw-MAD-of-ys formulation masked exactly
+   that case), and a constant or noisy-flat series must NOT flag (the
+   MAD floors).
+
+The 100k-session soak itself is ``@slow``; a 3k-session cell rides
+tier-1 via the same ``measure_soak`` entry ci_check --soak-smoke uses.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.telemetry import (
+    Decl,
+    GrowthSentinel,
+    NULL_MONITOR,
+    ResourceMonitor,
+    StructCensus,
+    audit_owner,
+    fit_growth,
+    mad_scale,
+    rss_mib,
+    undeclared_containers,
+)
+from pytorch_distributed_tpu.telemetry.flightrec import read_mirror
+from pytorch_distributed_tpu.telemetry.latency import LatencySeries
+from pytorch_distributed_tpu.telemetry.reqtrace import ReqTracer
+from pytorch_distributed_tpu.telemetry.schema import validate_stream
+from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+
+# ---------------------------------------------------------------------------
+# 1. the pipeline itself: rotation + mirror stitching at volume
+# ---------------------------------------------------------------------------
+
+def test_metrics_logger_rotates_and_mirror_stitches(tmp_path):
+    """Soak volume through a capped log: every record survives exactly
+    one rotation boundary away, in order, with disk bounded."""
+    path = str(tmp_path / "m.jsonl")
+    n = 3000
+    with MetricsLogger(path, max_bytes=32 << 10) as mlog:
+        for i in range(n):
+            mlog.log(kind="resource", seq=i, rss_mib=100.0 + i * 0.001,
+                     rss_source="proc", live=3, cumulative=i)
+        rotations = mlog.rotations
+    assert rotations >= 2, "cap never tripped — rotation path untested"
+    # only two generations on disk, both under ~the cap
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".2")
+    assert os.path.getsize(path) <= (32 << 10) + 4096
+    events = read_mirror(path)
+    seqs = [e["seq"] for e in events]
+    # the mirror keeps the NEWEST window (older generations are gone by
+    # design) and what it keeps is contiguous and in write order
+    assert seqs == list(range(seqs[0], n))
+    assert len(events) >= 2, "mirror lost the rotated generation"
+
+
+def test_read_mirror_skips_torn_tail_and_reopen_appends(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path) as mlog:
+        for i in range(10):
+            mlog.log(kind="census", seq=i, ok=True, violations=0,
+                     structures={}, worst_ratio=0.0)
+    # SIGKILL mid-write leaves a torn final line
+    with open(path, "a") as f:
+        f.write('{"kind": "census", "seq": 10, "ok": tr')
+    events = read_mirror(path)
+    assert [e["seq"] for e in events] == list(range(10))
+    # a relaunch reopens in append mode: old records stay, new ones land
+    with MetricsLogger(path) as mlog:
+        mlog.log(kind="census", seq=11, ok=True, violations=0,
+                 structures={}, worst_ratio=0.0)
+    events = read_mirror(path)
+    assert events[-1]["seq"] == 11
+    assert events[0]["seq"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 2a. census primitives
+# ---------------------------------------------------------------------------
+
+class _Owner:
+    """Minimal owner with one of each bound class + a callable cap."""
+
+    def __init__(self):
+        self.ring = []            # fixed
+        self.per_req = {}         # live
+        self.lanes = []           # replicas
+        self.log = []             # unbounded (declared as such)
+        self.cap = 4
+
+    def census_decls(self):
+        return [
+            Decl("ring", "fixed", cap=lambda o: o.cap, why="test ring"),
+            Decl("per_req", "live", per_live=2, why="2 entries per live"),
+            Decl("lanes", "replicas", why="one lane per replica"),
+            Decl("log", "unbounded", why="caller-owned; never audited"),
+        ]
+
+
+def test_audit_owner_bound_classes():
+    o = _Owner()
+    o.ring = list(range(4))
+    o.per_req = {i: i for i in range(6)}
+    o.lanes = [0, 1]
+    o.log = list(range(10_000))
+    sizes, viol, undecl = audit_owner("o", o, live=3, replicas=2)
+    assert sizes == {"o.ring": 4, "o.per_req": 6, "o.lanes": 2,
+                     "o.log": 10_000}
+    assert viol == [] and undecl == []
+    # fixed: one past the (callable) cap flags
+    o.ring.append(99)
+    _, viol, _ = audit_owner("o", o, live=3, replicas=2)
+    assert [v["name"] for v in viol] == ["o.ring"]
+    assert viol[0]["bound"] == 4 and viol[0]["size"] == 5
+    o.ring.pop()
+    # live: bound scales with live count (2 per live + slack)
+    o.per_req = {i: i for i in range(9)}
+    _, viol, _ = audit_owner("o", o, live=3, replicas=2, live_slack=2)
+    assert [v["name"] for v in viol] == ["o.per_req"]  # 9 > 2*3+2
+    _, viol, _ = audit_owner("o", o, live=4, replicas=2, live_slack=2)
+    assert viol == []                                  # 9 <= 2*4+2
+    # live with live=None: skipped, never a false flag
+    _, viol, _ = audit_owner("o", o, live=None, replicas=2)
+    assert viol == []
+    # replicas: one lane past the replica count flags
+    o.lanes = [0, 1, 2]
+    _, viol, _ = audit_owner("o", o, live=99, replicas=2)
+    assert [v["name"] for v in viol] == ["o.lanes"]
+    # unbounded never flags, however big
+    o.lanes = [0, 1]
+    o.log = list(range(1_000_000))
+    _, viol, _ = audit_owner("o", o, live=99, replicas=2)
+    assert viol == []
+
+
+def test_undeclared_container_is_loud():
+    o = _Owner()
+    o.scratch = {}  # the leak-in-waiting: a container nobody declared
+    assert undeclared_containers(o) == ["scratch"]
+    _, _, undecl = audit_owner("o", o, live=1, replicas=1)
+    assert undecl == ["o.scratch"]
+    c = StructCensus()
+    c.register("o", o)
+    rec = c.sweep(live=1, replicas=1)
+    assert rec["ok"] is False and rec["undeclared"] == ["o.scratch"]
+    assert c.verdict() == "undeclared:1"
+
+
+def test_dotted_decl_does_not_cover_direct_attr():
+    """Decl("ttft.values") reaches through; it must not silence a
+    sibling container literally named ``ttft``."""
+
+    class O:
+        def __init__(self):
+            self.ttft = []
+
+        def census_decls(self):
+            return [Decl("ttft.values", "fixed", cap=8, why="reach-through")]
+
+    assert undeclared_containers(O()) == ["ttft"]
+
+
+def test_census_sweep_verdict_and_peaks(tmp_path):
+    path = str(tmp_path / "c.jsonl")
+    o = _Owner()
+    with MetricsLogger(path) as mlog:
+        c = StructCensus(mlog)
+        c.register("o", o)
+        o.ring = [1, 2]
+        c.sweep(live=1, replicas=1, tick=0)
+        o.ring = [1, 2, 3]
+        c.sweep(live=1, replicas=1, tick=1)
+        o.ring = [1]
+        rec = c.sweep(live=1, replicas=1, tick=2)
+    assert rec["ok"] is True
+    assert c.verdict() == "ok"
+    assert c.peak["o.ring"] == 3  # peaks survive the shrink
+    assert rec["worst_ratio"] == 0.25 and rec["worst_name"] == "o.ring"
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    assert [r["kind"] for r in rows] == ["census"] * 3
+    assert validate_stream(rows) == [], validate_stream(rows)[:3]
+
+
+# ---------------------------------------------------------------------------
+# 2b. the meta-test: every swept owner in a REAL fleet is fully declared
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_fleet():
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.fleet import FleetRouter
+    from pytorch_distributed_tpu.models.transformer import (
+        TransformerLM,
+        tiny_config,
+    )
+
+    cfg = tiny_config(attention="dense", max_seq_len=96)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def _serve(cfg, params, **kw):
+    from pytorch_distributed_tpu.fleet import FleetRouter
+
+    rng = np.random.default_rng(0)
+    router = FleetRouter(cfg, params, n_replicas=2, n_slots=3,
+                         block_len=8, prefill_chunk=8, **kw)
+    rids = [router.submit(
+        rng.integers(1, cfg.vocab_size, (9 + i,)).astype(np.int32), 5)
+        for i in range(4)]
+    out = router.drain(max_steps=4000)
+    return router, rids, out
+
+
+def test_census_meta_no_undeclared_containers(tiny_fleet):
+    """THE tripwire: add a dict/list/set/deque to any swept class
+    without a Decl and this fails, naming it. That is the point."""
+    cfg, params = tiny_fleet
+    for retain in (True, False):
+        router, _, _ = _serve(cfg, params, retain_results=retain)
+        owners = router.census_owners()
+        assert owners, "router exposed no census owners"
+        for name, obj in owners:
+            undecl = undeclared_containers(obj)
+            assert undecl == [], (
+                f"{name} ({type(obj).__name__}) grew undeclared "
+                f"container(s) {undecl} — add a Decl with a bound class "
+                f"(fixed/live/replicas/unbounded) and a why")
+
+
+def test_census_sweep_clean_on_live_fleet(tiny_fleet):
+    cfg, params = tiny_fleet
+    router, rids, out = _serve(cfg, params, retain_results=False)
+    census = StructCensus()
+    census.register_many(router.census_owners())
+    rec = census.sweep(live=router.live_requests(), replicas=2,
+                       live_slack=12)
+    assert rec["ok"] is True, rec["violation_details"] or rec["undeclared"]
+    assert census.verdict() == "ok"
+    assert all(len(out.get(r, [])) == 0 for r in rids)  # streaming drops
+
+
+# ---------------------------------------------------------------------------
+# 2c. leak regressions (the fixes the census caught, pinned forever)
+# ---------------------------------------------------------------------------
+
+def test_reqtracer_roots_purged_on_close():
+    tr = ReqTracer(enabled=True)
+    for rid in range(50):
+        root = tr.open_root(rid)
+        s = tr.begin(rid, "decode", parent=root)
+        tr.end(s)
+        tr.end(root)
+    assert tr.open_traces() == []
+    assert tr.open_spans() == []
+    # per-rid root registry must not retain closed traces (O(live), not
+    # O(sessions ever)) — this is what the ``live`` census bound audits
+    sizes, viol, _ = audit_owner("reqtrace", tr, live=0, live_slack=4)
+    assert viol == [], viol
+    # end() after the root is gone is a no-op, not a resurrection
+    tr.end(root)
+    assert tr.open_traces() == []
+
+
+def test_latency_series_window_bounded():
+    s = LatencySeries("ttft", window=64)
+    for i in range(1000):
+        s.observe(i * 1e-3)
+    assert len(s) == 1000                      # cumulative count intact
+    assert len(s.window_values()) == 64        # percentile window capped
+    sizes, viol, _ = audit_owner("lat", s, live=0)
+    assert viol == [], viol
+    assert all(v <= 2 * 64 for v in sizes.values()), sizes
+    sm = s.summary("ttft")
+    assert sm["ttft_count"] == 1000
+    assert sm["ttft_max_s"] == pytest.approx(0.999)
+
+
+def test_router_streaming_retention(tiny_fleet):
+    """retain_results=False: per-request state is GONE after retire;
+    retain_results=True keeps the full transcript (the default)."""
+    cfg, params = tiny_fleet
+    router, rids, out = _serve(cfg, params, retain_results=True)
+    assert all(len(out[r]) == 5 for r in rids)
+    assert router._origin == {}  # popped at retire in EVERY mode
+    assert router.metrics()["results_dropped"] == 0
+
+    router, rids, out = _serve(cfg, params, retain_results=False)
+    assert out == {} or all(len(v) == 0 for v in out.values())
+    assert router.results == {}
+    assert router._origin == {}
+    m = router.metrics()
+    assert m["results_dropped"] == len(rids)
+    assert m["completed"] == len(rids)  # counters outlive the payloads
+
+
+def test_router_reject_table_capped(tiny_fleet):
+    cfg, params = tiny_fleet
+    from pytorch_distributed_tpu.fleet import FleetRouter
+
+    router = FleetRouter(cfg, params, n_replicas=1, n_slots=3,
+                         block_len=8, prefill_chunk=8,
+                         retain_results=False)
+    cap = FleetRouter._REJECT_CAP
+    prompt = np.arange(1, 9, dtype=np.int32)
+    n = cap + 50
+    for _ in range(n):
+        router.submit(prompt, 4, deadline_s=-0.01)  # sheds at admission
+    assert len(router.rejected) <= cap
+    assert router.metrics()["shed"] == n  # the counter stays exact
+
+
+# ---------------------------------------------------------------------------
+# 3. growth sentinel: flags real growth, holds its tongue on noise
+# ---------------------------------------------------------------------------
+
+def test_fit_growth_linear_ramp_flags():
+    """Noise-free linear growth MUST flag. The naive scale =
+    MAD(ys) formulation sees the trend itself as spread and stays
+    silent — this is the regression test for the residual-based fix."""
+    xs = list(range(0, 3200, 100))
+    ys = [100.0 + 0.05 * x for x in xs]
+    fit = fit_growth(xs, ys, abs_floor=1.0)
+    assert fit["verdict"] == "linear", fit
+    assert fit["slope"] == pytest.approx(0.05, rel=1e-6)
+
+
+def test_fit_growth_flat_and_noise_floors():
+    xs = list(range(0, 3200, 100))
+    # bit-identical constant: MAD is 0, the floors keep scale > 0
+    fit = fit_growth(xs, [137.0] * len(xs), abs_floor=1.0)
+    assert fit["verdict"] == "flat", fit
+    # trendless noise around a level: stays flat
+    rng = np.random.default_rng(7)
+    ys = [200.0 + float(rng.normal(0, 2.0)) for _ in xs]
+    fit = fit_growth(xs, ys, abs_floor=1.0)
+    assert fit["verdict"] == "flat", fit
+    # the same noise ON a ramp still flags
+    ys = [200.0 + 0.05 * x + float(rng.normal(0, 2.0)) for x in xs]
+    fit = fit_growth(xs, ys, abs_floor=1.0)
+    assert fit["verdict"] in ("linear", "superlinear"), fit
+
+
+def test_fit_growth_superlinear_and_insufficient():
+    xs = list(range(0, 3200, 100))
+    fit = fit_growth(xs, [100.0 + 1e-4 * x * x for x in xs], abs_floor=1.0)
+    assert fit["verdict"] == "superlinear", fit
+    assert fit_growth([1, 2], [1.0, 2.0])["verdict"] == "insufficient"
+
+
+def test_mad_scale_floors():
+    assert mad_scale([5.0] * 20, rel_floor=0.05) == pytest.approx(0.25)
+    assert mad_scale([0.0] * 20, abs_floor=1e-9) == pytest.approx(1e-9)
+
+
+def test_growth_sentinel_flags_and_is_bounded():
+    s = GrowthSentinel(window=256, threshold=4.0, abs_floor=0.5)
+    for i in range(64):
+        x = float(i * 100)
+        s.observe_sizes(x, {"leaky": int(10 + i * 5), "steady": 32})
+    rep = s.report()
+    assert rep["size:leaky"]["verdict"] in ("linear", "superlinear")
+    assert rep["size:steady"]["verdict"] == "flat"
+    assert s.flags() == ["size:leaky"]
+    # the sentinel's own rings are census-declared and bounded
+    assert undeclared_containers(s) == []
+    _, viol, _ = audit_owner("sentinel", s)
+    assert viol == []
+
+
+# ---------------------------------------------------------------------------
+# 4. resource monitor: cadence, schema, tracemalloc, null object
+# ---------------------------------------------------------------------------
+
+def test_rss_mib_reads_something():
+    val, source = rss_mib()
+    assert val > 1.0
+    assert source in ("proc", "rusage")
+
+
+def test_resource_monitor_cadence_and_schema(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    with MetricsLogger(path) as mlog:
+        mon = ResourceMonitor(mlog, every_ticks=10, gc_objects=True,
+                              tracemalloc_every=2, top_sites=3)
+        for t in range(95):
+            mon.tick(live=t % 7, cumulative=t, wall_s=0.001)
+        mon.close()
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    assert len(rows) == 9  # ticks 10, 20, ... 90
+    assert validate_stream(rows) == [], validate_stream(rows)[:3]
+    for r in rows:
+        assert r["kind"] == "resource"
+        assert r["rss_mib"] > 1.0 and r["rss_source"] in ("proc", "rusage")
+        assert r["cumulative"] % 10 == 9  # sampled ON the cadence tick
+        assert "gc_objects" in r
+    # tracemalloc armed lazily, then every 2nd sample carries top sites
+    tm = [r for r in rows if "tracemalloc_top" in r]
+    assert len(tm) >= 3
+    assert all(len(r["tracemalloc_top"]) <= 3 for r in tm)
+    # series come back as (xs, ys) ready for fit_growth
+    xs, ys = mon.rss_series()
+    assert len(xs) == len(ys) == 9
+    assert fit_growth(xs, ys, rel_floor=0.005, abs_floor=1.0)[
+        "verdict"] in ("flat", "linear", "insufficient")
+    # the monitor audits itself: history ring declared and bounded
+    assert undeclared_containers(mon) == []
+
+
+def test_resource_monitor_disabled_and_null():
+    mon = ResourceMonitor(None, every_ticks=1, enabled=False)
+    for t in range(5):
+        mon.tick(live=0, cumulative=t, wall_s=0.0)
+    assert mon.rss_series() == ([], [])
+    for t in range(5):  # the shared no-op object: safe to hammer
+        NULL_MONITOR.tick(live=0, cumulative=t, wall_s=0.0)
+    NULL_MONITOR.close()
+
+
+# ---------------------------------------------------------------------------
+# 5. the soak harness end-to-end (tier-1 miniature + @slow heavy cell)
+# ---------------------------------------------------------------------------
+
+def _run_soak(tmp_path, requests, **kw):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_serving",
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                     "bench_serving.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench.measure_soak(
+        requests=requests, out_path=str(tmp_path / "soak.jsonl"), **kw)
+
+
+def test_soak_miniature(tmp_path):
+    """The --soak path itself: stream sessions through the 2-replica
+    fleet with the observatory armed; census must close ok and the
+    telemetry must round-trip the rotated mirror."""
+    row = _run_soak(tmp_path, 300, log_max_bytes=64 << 10)
+    assert row["serving_soak_sessions"] == 300
+    assert row["serving_soak_completed"] + row["serving_soak_shed"] == 300
+    assert row["serving_soak_census_verdict"] == "ok"
+    assert row["serving_soak_census_undeclared"] == 0
+    assert row["serving_soak_undeclared_at_start"] == 0
+    assert row["serving_soak_results_dropped"] == \
+        row["serving_soak_completed"]
+    assert row["serving_soak_rss_mib_final"] > 1.0
+    events = read_mirror(str(tmp_path / "soak.jsonl"))
+    kinds = {e.get("kind") for e in events}
+    assert "resource" in kinds and "census" in kinds
+    assert validate_stream(events) == [], validate_stream(events)[:3]
+
+
+@pytest.mark.slow
+def test_soak_heavy(tmp_path):
+    """~20k sessions: enough x-range for the RSS fit to mean something
+    off the shared-CPU noise floor. The 100k run is the BENCH row."""
+    row = _run_soak(tmp_path, 20_000)
+    assert row["serving_soak_census_verdict"] == "ok"
+    assert row["serving_soak_census_violations"] == 0
+    assert row["serving_soak_census_undeclared"] == 0
+    assert row["serving_soak_rss_verdict"] in ("flat", "linear"), row
+    assert row["serving_soak_rss_slope_mib_per_10k"] < 20.0, row
+    assert row["serving_soak_size_flags"] == "none", row
