@@ -86,7 +86,7 @@ from __future__ import annotations
 import logging
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -272,6 +272,15 @@ class FleetRouter:
         # entries in this mode (counters stay exact).
         self.retain_results = bool(retain_results)
         self._retired_pending: List[int] = []
+        # round 22 (HTTP front door): optional FLEET-level retire hook,
+        # ``on_retire(rid, outcome)`` — one call per terminal transition
+        # (complete / cancelled / deadline / failed), fired on the host-
+        # loop thread from every terminal path: scheduler retire, failed
+        # re-dispatch, router-side deadline expiry, redispatch-noop. The
+        # gateway uses it to close SSE streams with the true outcome.
+        # It fires mid-collect, BEFORE the final token lands in
+        # ``results`` — consumers must drain queued tokens first.
+        self.on_retire: Optional[Callable[[int, str], None]] = None
         self._results_dropped = 0
         self._spilled = 0
         self._preempt_routes = 0
@@ -458,6 +467,8 @@ class FleetRouter:
         self._origin.pop(rid, None)
         if not self.retain_results:
             self._retired_pending.append(rid)
+        if self.on_retire is not None:
+            self.on_retire(rid, outcome)
 
     def _drop_retired(self) -> None:
         if self.retain_results or not self._retired_pending:
@@ -688,6 +699,8 @@ class FleetRouter:
                 reject_reason=reason, outcome="failed",
                 new_tokens=len(self.results.get(rid, ())),
             )
+        if self.on_retire is not None:
+            self.on_retire(rid, "failed")
 
     def _expire_request(self, rid: int, where: str) -> None:
         """Deadline lapsed while the request sat in the router's own
@@ -710,6 +723,8 @@ class FleetRouter:
                 outcome="deadline",
                 new_tokens=len(self.results.get(rid, ())),
             )
+        if self.on_retire is not None:
+            self.on_retire(rid, "deadline")
 
     def _pump_redispatch(self) -> None:
         """Re-submit harvested requests to surviving entry replicas.
@@ -751,6 +766,8 @@ class FleetRouter:
                 self._origin.pop(rid, None)
                 if not self.retain_results:
                     self._retired_pending.append(rid)
+                if self.on_retire is not None:
+                    self.on_retire(rid, "complete")
                 continue
             prompt = origin["prompt"]
             if delivered:

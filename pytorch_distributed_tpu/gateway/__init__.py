@@ -1,0 +1,31 @@
+"""HTTP/SSE serving front door over the fleet (round 22).
+
+``server.Gateway`` mounts ``POST /v1/generate`` (SSE token streaming),
+``GET /v1/health`` (the PR 17 health plane), and the Prometheus
+``/metrics`` over a ``FleetRouter``, with ``X-Deadline-Ms`` → admission
+deadlines, ``SLOGate`` shed → 429 + ``Retry-After``, and client
+disconnect → ``FleetRouter.cancel`` (blocks freed, span tree closed
+``outcome=cancelled``). ``client`` is the stdlib SSE client the tests
+and ``bench_serving.py --http`` drive it with. ANALYSIS.md "Front
+door" documents the protocol.
+"""
+
+from pytorch_distributed_tpu.gateway.client import (
+    GatewayError,
+    SSEStream,
+    generate,
+    health,
+    metrics_text,
+    open_stream,
+)
+from pytorch_distributed_tpu.gateway.server import Gateway
+
+__all__ = [
+    "Gateway",
+    "GatewayError",
+    "SSEStream",
+    "generate",
+    "health",
+    "metrics_text",
+    "open_stream",
+]

@@ -78,6 +78,17 @@ REQUIRED_KEYS: Dict[str, FrozenSet[str]] = {
     # verdict + per-structure sizes; violation_details/undeclared carry
     # the loud-finding payloads
     "census": frozenset({"ok", "violations", "structures", "worst_ratio"}),
+    # gateway/server.py per-connection ingress records (round 22): one
+    # per /v1/generate connection — rid (-1 when rejected before
+    # admission), HTTP status, the X-Deadline-Ms budget (null when
+    # absent), whether the client disconnected, SSE bytes written, and
+    # TTFT measured over the wire (null when no token ever reached the
+    # socket); outcome/tokens/reason/gap_max_ms/open/queued ride as
+    # optional extras
+    "http": frozenset(
+        {"rid", "route", "status", "deadline", "disconnect", "bytes",
+         "ttft_wire"}
+    ),
 }
 
 #: additional required keys per span ``ev`` (see reqtrace module docs)

@@ -67,6 +67,19 @@ swap`` forces the preemption path the trace smoke audits
         --preempt --swap-policy swap --metrics-out spans.jsonl
     python scripts/explain_request.py spans.jsonl --find preempted
 
+Front door (round 22; ANALYSIS.md "Front door"): ``--http-port PORT``
+(0 picks an ephemeral port, printed at startup) serves the fleet over
+HTTP instead of replaying the synthetic workload — ``POST
+/v1/generate`` streams tokens as Server-Sent Events with
+``X-Deadline-Ms`` mapped onto the admission deadline, SLO sheds
+surfacing as 429 + ``Retry-After``, and client disconnects cancelling
+the request (KV blocks freed, span tree closed ``outcome=cancelled``);
+``GET /v1/health`` is the round-19 health plane and ``/metrics`` the
+Prometheus text. ``--http-duration`` bounds the serve window:
+
+    python recipes/serve_lm.py --tiny --replicas 2 --http-port 8080 \
+        --slo-ttft-ms 500 --metrics-out http.jsonl
+
 Cold start (round 8; ANALYSIS.md "Cold start & compile cache"):
 ``--warmup`` compiles every registry program (decode tick + all prefill
 buckets) before admitting traffic, and ``--compile-cache-dir`` points
@@ -248,6 +261,17 @@ def _parse() -> argparse.Namespace:
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve live Prometheus-text /metrics while the "
                         "cycle runs (stdlib HTTP thread)")
+    p.add_argument("--http-port", type=int, default=None, metavar="PORT",
+                   help="serve the HTTP/SSE front door (gateway/) on "
+                        "PORT (0 = ephemeral) instead of replaying the "
+                        "synthetic workload: POST /v1/generate streams "
+                        "tokens, GET /v1/health is the health plane, "
+                        "/metrics the Prometheus text; implies the "
+                        "fleet layout with the async host loop and "
+                        "streaming retention")
+    p.add_argument("--http-duration", type=float, default=10.0,
+                   help="seconds to keep the front door up "
+                        "(--http-port)")
     return p.parse_args()
 
 
@@ -286,7 +310,14 @@ def _prompts(args, cfg):
             for l in lens]
 
 
+# the live front-door instance when --http-port is up — an in-process
+# driver (a test thread, a notebook) polls serve_lm.GATEWAY.port instead
+# of scraping stdout for the ephemeral port
+GATEWAY = None
+
+
 def main() -> None:
+    global GATEWAY
     args = _parse()
     from pytorch_distributed_tpu.utils.env import resolve_compile_cache_dir
 
@@ -318,8 +349,9 @@ def main() -> None:
         else NULL_REQTRACER
     )
     t0 = time.perf_counter()
+    http_mode = args.http_port is not None
     fleet_mode = (args.replicas > 1 or args.disaggregate or args.trace
-                  or args.async_host)
+                  or args.async_host or http_mode)
     if args.dense and (args.cost_cards or args.metrics_port is not None):
         raise SystemExit("--cost-cards/--metrics-port need the paged "
                          "layout (program registry + scheduler metrics); "
@@ -359,7 +391,10 @@ def main() -> None:
             disaggregate=args.disaggregate,
             n_prefill=args.prefill_replicas, slo=slo, seed=args.seed,
             metrics_log=mlog, tracer=tracer, reqtrace=reqtrace,
-            async_host=args.async_host,
+            # the front door streams: async host loop, results dropped
+            # at retire (the connection consumed them token by token)
+            async_host=args.async_host or http_mode,
+            retain_results=not http_mode,
             n_slots=args.slots,
             block_len=args.block_len, prefill_chunk=args.prefill_chunk,
             admit_per_step=args.admit_per_step, n_blocks=args.n_blocks,
@@ -377,7 +412,22 @@ def main() -> None:
                 router.metrics, port=args.metrics_port
             ).start()
             rank0_print(f"metrics: http://127.0.0.1:{exporter.port}/metrics")
-        if args.trace:
+        if http_mode:
+            from pytorch_distributed_tpu.gateway import Gateway
+
+            GATEWAY = gw = Gateway(router, port=args.http_port,
+                                   metrics_log=mlog)
+            gw.start()
+            rank0_print(
+                f"gateway: http://127.0.0.1:{gw.port}/v1/generate "
+                f"(health /v1/health, metrics /metrics; up for "
+                f"{args.http_duration:.0f}s)")
+            try:
+                time.sleep(args.http_duration)
+            finally:
+                gw.stop()
+                router.drain()
+        elif args.trace:
             trace = clamp_trace(
                 load_trace(args.trace), cfg.max_seq_len,
                 args.prefill_chunk,

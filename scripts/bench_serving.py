@@ -32,6 +32,11 @@ Usage: python scripts/bench_serving.py [--slots 32]
            --soak-log soak.jsonl --soak-slots 8 --soak-replicas 2]
            # round 21 scale observatory: stream >=100k unique-session
            # requests, census + RSS/host-wall growth fits (serving_soak_*)
+       python scripts/bench_serving.py --http [--http-requests 48
+           --http-replicas 2 --http-disconnect-every 6 --http-out h.jsonl]
+           # round 22 front door: real sockets against gateway.Gateway —
+           # over-the-wire TTFT, SSE gap p95, 429 rate at the door, and
+           # cancel-to-block-free latency (serving_http_*)
 
 Round 15 (overlap profiler): ``--wall-clock`` is the ROADMAP-item-3
 fleet bench — ONE trace served saturated (no nominal tick) by 1 replica
@@ -1515,6 +1520,146 @@ def measure_soak(requests: int = 100_000, out_path: str | None = None,
     return out
 
 
+def measure_http(requests: int = 48, seed: int = 0, slots: int = 4,
+                 replicas: int = 2, disconnect_every: int = 6,
+                 max_conc: int = 8, time_scale: float = 0.05,
+                 out_path: str | None = None) -> dict:
+    """The HTTP front door measured OVER THE WIRE (ISSUE 20): a real
+    socket per request against ``gateway.Gateway`` on an ephemeral
+    port, paced by the stock bursty trace (time-scaled so the bench
+    stays in seconds). Every ``disconnect_every``-th request hangs up
+    after its first token — the disconnect→cancel path is part of the
+    steady state being measured, not a separate scenario.
+
+    Reports what in-process benches cannot see: TTFT measured at the
+    socket (``serving_http_ttft_wire_*`` — admission + first decode +
+    serialization + kernel send), the inter-token stream gap p95 (the
+    SSE jitter a client actually experiences), the 429 shed rate at
+    the door, and the cancel-to-block-free latency (socket close →
+    ``FleetRouter.cancel`` freed the KV blocks).
+
+    HONESTY (``serving_http_backend``): loopback TCP on a shared CPU
+    host — wire latencies carry the host's scheduler noise and a tiny
+    model's decode rate; magnitudes are structural (is TTFT dominated
+    by queueing? do gaps spike at bursts?), not device claims.
+    """
+    import itertools
+    import tempfile
+    import threading
+
+    from pytorch_distributed_tpu.fleet import (
+        FleetRouter,
+        iter_trace,
+        prompt_for,
+    )
+    from pytorch_distributed_tpu.gateway import (
+        Gateway,
+        generate,
+        open_stream,
+    )
+    from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+    cfg, params = _tiny_model()
+    tmp = None
+    if out_path is None:
+        tmp = tempfile.TemporaryDirectory()
+        out_path = os.path.join(tmp.name, "http.jsonl")
+    mlog = MetricsLogger(out_path)
+    router = FleetRouter(
+        cfg, params, n_replicas=replicas, seed=seed, metrics_log=mlog,
+        n_slots=slots, block_len=16, prefill_chunk=32,
+        retain_results=False, async_host=True,
+    )
+    router.warmup()
+    gw = Gateway(router, port=0, metrics_log=mlog)
+    gw.start()
+    base = f"http://127.0.0.1:{gw.port}"
+
+    trace = list(itertools.islice(
+        iter_trace(seed=seed, duration_s=1e12, base_rate=2.0,
+                   burst_rate_mult=4.0, burst_every_s=40.0,
+                   burst_len_s=6.0, prompt_median=16, prompt_max=64,
+                   max_new_median=6, max_new_max=12,
+                   unique_sessions=True),
+        requests,
+    ))
+    statuses: list = []
+    disconnects = [0]
+    gate = threading.Semaphore(max_conc)
+    lock = threading.Lock()
+
+    def run_one(i, req, t_start):
+        # pace to the (scaled) trace arrival, bounded concurrency
+        delay = req.t * time_scale - (time.perf_counter() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        prompt = prompt_for(req, cfg.vocab_size, seed=seed)
+        with gate:
+            if disconnect_every and i % disconnect_every == \
+                    disconnect_every - 1:
+                try:
+                    st = open_stream(base, prompt, req.max_new,
+                                     session=req.session, timeout=60.0)
+                    next(st.events())
+                    st.close()
+                    with lock:
+                        statuses.append(200)
+                        disconnects[0] += 1
+                except Exception:
+                    with lock:
+                        statuses.append(-1)
+                return
+            out = generate(base, prompt, req.max_new,
+                           session=req.session, timeout=60.0)
+            with lock:
+                statuses.append(out["status"])
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=run_one, args=(i, r, t_start),
+                                daemon=True)
+               for i, r in enumerate(trace)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    wall = time.perf_counter() - t_start
+    gm = gw.metrics()
+    gw.stop()
+    router.drain(max_steps=20_000)
+    m = router.metrics()
+    mlog.close()
+
+    served = sum(1 for s in statuses if s == 200)
+    shed = sum(1 for s in statuses if s == 429)
+    out = {
+        "serving_http_backend": jax.default_backend(),
+        "serving_http_requests": len(statuses),
+        "serving_http_served": served,
+        "serving_http_shed": shed,
+        "serving_http_429_rate": round(shed / max(len(statuses), 1), 4),
+        "serving_http_errors": sum(1 for s in statuses
+                                   if s not in (200, 429)),
+        "serving_http_disconnects": disconnects[0],
+        "serving_http_cancelled": m["cancelled"],
+        "serving_http_wall_s": round(wall, 2),
+        "serving_http_tokens_out": m["tokens_out"],
+        "serving_http_ttft_wire_p50_ms": round(
+            gm.get("gateway_ttft_wire_p50_s", 0.0) * 1e3, 2),
+        "serving_http_ttft_wire_p95_ms": round(
+            gm.get("gateway_ttft_wire_p95_s", 0.0) * 1e3, 2),
+        "serving_http_gap_p95_ms": round(
+            gm.get("gateway_gap_p95_s", 0.0) * 1e3, 2),
+        "serving_http_worst_gap_ms": gm.get("gateway_worst_gap_ms", 0.0),
+        "serving_http_cancel_free_p95_ms": round(
+            gm.get("gateway_cancel_free_p95_s", 0.0) * 1e3, 2),
+        "serving_http_bytes_out": gm.get("gateway_bytes_out", 0),
+        "device": str(jax.devices()[0]),
+    }
+    if tmp is not None:
+        tmp.cleanup()
+    return out
+
+
 def link_probe(mb: int = 16, reps: int = 5) -> dict:
     """Same-run bandwidth/link probe, co-quoted with every serving bench
     row (ISSUE 8, ADVICE §6 — the ckpt bench's same-minute disk-probe
@@ -1643,6 +1788,15 @@ def main() -> None:
             replicas=_argval("--soak-replicas", 2, int),
             every_ticks=_argval("--soak-every", None, int),
             log_max_bytes=int(_argval("--soak-log-mb", 4.0) * 2**20),
+        ), **probe}))
+        return
+    if "--http" in sys.argv:
+        print(json.dumps({**measure_http(
+            requests=_argval("--http-requests", 48, int),
+            slots=_argval("--http-slots", 4, int),
+            replicas=_argval("--http-replicas", 2, int),
+            disconnect_every=_argval("--http-disconnect-every", 6, int),
+            out_path=_argval("--http-out", None, str),
         ), **probe}))
         return
     if "--pressure" in sys.argv:

@@ -13,7 +13,8 @@
 #                             --overlap-smoke|--async-smoke|
 #                             --prefix-smoke|--blocksan-smoke|
 #                             --chaos-smoke|--tune-smoke|
-#                             --soak-smoke|--bench-regression]
+#                             --soak-smoke|--gateway-smoke|
+#                             --bench-regression]
 #
 # --lint-incremental: jaxlint via the content-hash cache
 # (.jaxlint_cache.json) — unchanged files serve from cache, cross-module
@@ -150,6 +151,16 @@
 # render the resource AND census sections from the rotated JSONL alone
 # (--require resource,census). The 100k-session run this miniaturizes
 # is the @slow soak + the BENCH_r09 row (~60 s).
+#
+# --gateway-smoke: lint, then the round-22 HTTP front-door cycle under
+# the block sanitizer: a 2-replica async fleet behind gateway.Gateway
+# on an ephemeral port serves one request to completion over SSE and
+# one that hangs up after its first token — the disconnect must reach
+# FleetRouter.cancel (blocks freed; the drain's fleet-wide ledger
+# quiesce proves it leak-free), explain_request.py --find cancelled
+# must reconstruct the hung-up request's span tree closed
+# outcome=cancelled, and telemetry_report.py must render the ingress
+# section from the kind="http" records (--require http).
 #
 # --bench-regression: lint, then compare the two newest BENCH_r0N.json
 # rounds key-by-key with per-key noise bands (scripts/bench_regression.py
@@ -597,6 +608,81 @@ PY
     JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
         "$smoke/soak.jsonl" --json --require resource,census > /dev/null
     echo "soak smoke OK"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--gateway-smoke" ]]; then
+    echo "== gateway smoke (SSE serve + mid-stream hangup -> cancel, ledger clean) =="
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    JAX_PLATFORMS=cpu python - "$smoke/gw.jsonl" <<'PY'
+import os
+import sys
+import time
+
+os.environ["PDT_BLOCKSAN"] = "1"
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.fleet import FleetRouter
+from pytorch_distributed_tpu.gateway import Gateway, generate, open_stream
+from pytorch_distributed_tpu.models.transformer import (
+    TransformerLM, tiny_config,
+)
+from pytorch_distributed_tpu.telemetry.reqtrace import ReqTracer
+from pytorch_distributed_tpu.utils.profiling import MetricsLogger
+
+cfg = tiny_config(attention="dense", max_seq_len=96)
+params = TransformerLM(cfg).init(
+    jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+)["params"]
+mlog = MetricsLogger(sys.argv[1])
+router = FleetRouter(
+    cfg, params, n_replicas=2, n_slots=3, block_len=8, prefill_chunk=8,
+    async_host=True, retain_results=False, metrics_log=mlog,
+    reqtrace=ReqTracer(sink=mlog),
+)
+gw = Gateway(router, port=0, metrics_log=mlog)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+rng = np.random.default_rng(0)
+prompt = rng.integers(1, cfg.vocab_size, (9,)).astype(np.int32)
+# request 1: a full SSE stream to completion over a real socket
+out = generate(base, prompt, 6)
+assert out["status"] == 200 and out["outcome"] == "complete", out
+assert len(out["tokens"]) == 6, out
+# request 2: hang up after the first token — the disconnect→cancel path
+st = open_stream(base, prompt, 40)
+next(st.events())
+st.close()
+deadline = time.time() + 30
+while time.time() < deadline and gw.metrics()["gateway_cancels"] < 1:
+    time.sleep(0.05)
+assert gw.metrics()["gateway_cancels"] >= 1, gw.metrics()
+gw.stop()
+router.drain(max_steps=4000)
+router.blocksan.assert_clean()
+assert router.metrics()["cancelled"] >= 1, router.metrics()
+router.log_summary()
+mlog.close()
+print("gateway serve: 1 stream completed, 1 hangup cancelled, "
+      "ledger clean")
+PY
+    JAX_PLATFORMS=cpu python scripts/explain_request.py \
+        "$smoke/gw.jsonl" --find cancelled --assert-complete \
+        > "$smoke/cancel.txt"
+    grep -q "terminal outcome: CANCELLED" "$smoke/cancel.txt" \
+        || { echo "explain output missing the cancelled outcome"; exit 1; }
+    JAX_PLATFORMS=cpu python scripts/telemetry_report.py \
+        "$smoke/gw.jsonl" --json --require http > /dev/null
+    # the two heavy gateway tests are @slow (fast tier sits ~60 s under
+    # its cap); node-id selection ignores -m, so they run here instead
+    JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+        -p no:xdist -p no:randomly \
+        "tests/test_gateway.py::test_disconnect_storm_leaks_zero_blocks" \
+        "tests/test_gateway.py::test_serve_lm_http_port_recipe"
+    echo "gateway smoke OK"
     exit 0
 fi
 

@@ -19,7 +19,7 @@ host loop):
     python scripts/explain_request.py serve.jsonl --rid 17 --assert-complete
     python scripts/explain_request.py serve.jsonl --perfetto out.trace.json
 
-``--find preempted|handed-off|shed|redispatched|failed|deadline|any``
+``--find preempted|handed-off|shed|redispatched|failed|deadline|cancelled|any``
 picks the first rid whose trace matches the predicate — the CI smoke
 uses it to assert a preempted AND a handed-off request both left
 complete traces without hard-coding rids; the round-19 predicates pick
@@ -115,6 +115,9 @@ FINDERS = {
     "redispatched": lambda recs, rid: _trace_has(recs, rid, "redispatch"),
     "failed": lambda recs, rid: _root_outcome(recs, rid) == "failed",
     "deadline": lambda recs, rid: _root_outcome(recs, rid) == "deadline",
+    # round 22: requests cancelled mid-flight (client hung up on the
+    # HTTP front door, or an explicit FleetRouter.cancel)
+    "cancelled": lambda recs, rid: _root_outcome(recs, rid) == "cancelled",
     "any": lambda recs, rid: True,
 }
 
@@ -318,6 +321,10 @@ def explain(records: List[dict], rid: int, out=None) -> int:
     elif outcome == "deadline":
         lines.append("terminal outcome: DEADLINE — the request's SLO "
                      "budget lapsed before completion")
+    elif outcome == "cancelled":
+        lines.append("terminal outcome: CANCELLED — the caller hung up "
+                     "(or cancelled explicitly); KV blocks freed "
+                     "mid-flight")
     for e in errors:
         lines.append(f"INCOMPLETE: {e}")
     print("\n".join(lines), file=out)

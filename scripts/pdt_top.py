@@ -22,7 +22,11 @@ fleet's shared stream) and re-render an aggregate view every
 - **resource** (round 21): newest RSS and its live slope against
   cumulative sessions (``kind="resource"`` monitor samples), plus the
   newest census sweep's verdict and worst bound ratio
-  (``kind="census"``) — the scale observatory's in-flight view.
+  (``kind="census"``) — the scale observatory's in-flight view;
+- **gateway** (round 22): front-door connection count, live open SSE
+  streams and queued ingress (the newest ``kind="http"`` record's
+  gauges), 429/400 counters, client disconnects, and the worst
+  inter-token stream gap seen over the wire.
 
 Only new bytes are read per refresh (the files are followed, not
 re-parsed), so tailing a long run is O(new events). ``--once`` renders
@@ -128,6 +132,14 @@ class View:
         self.overlap_summary: Dict[int, dict] = {}
         self.overlap_launches = 0
         self.recent_bubbles: List[dict] = []
+        # HTTP front door (round 22; kind="http" per-connection
+        # records): lifetime counters plus the newest record's live
+        # open/queued gauges and the worst inter-token stream gap
+        self.http_conns = 0
+        self.http_429 = 0
+        self.http_400 = 0
+        self.http_disconnects = 0
+        self.http_worst_gap_ms = 0.0
 
     def feed(self, records: List[dict]) -> None:
         for r in records:
@@ -186,6 +198,18 @@ class View:
                     self.recent_bubbles.append(r)
                     if len(self.recent_bubbles) > self.window:
                         self.recent_bubbles.pop(0)
+            elif kind == "http":
+                self.http_conns += 1
+                status = r.get("status", 0)
+                if status == 429:
+                    self.http_429 += 1
+                elif status == 400:
+                    self.http_400 += 1
+                if r.get("disconnect"):
+                    self.http_disconnects += 1
+                gap = r.get("gap_max_ms") or 0.0
+                if gap > self.http_worst_gap_ms:
+                    self.http_worst_gap_ms = gap
             elif kind == "span":
                 self.span_records += 1
                 key = (r.get("trace"), r.get("span"))
@@ -257,6 +281,18 @@ class View:
             if gaps:
                 line += (f"  tok {gaps['p50'] * 1e3:.1f}/"
                          f"{gaps['p95'] * 1e3:.1f} ms")
+            out.append(line)
+        if self.http_conns:
+            # front-door row (round 22): the newest record carries the
+            # live open-streams / queued-ingress gauges as extras
+            newest = self.last.get("http") or {}
+            line = (f"gateway  {self.http_conns} conns, "
+                    f"{newest.get('open', 0)} open streams, "
+                    f"{newest.get('queued', 0)} queued  "
+                    f"429={self.http_429}  400={self.http_400}  "
+                    f"disconnects={self.http_disconnects}")
+            if self.http_worst_gap_ms:
+                line += f"  worst gap {self.http_worst_gap_ms:.1f} ms"
             out.append(line)
         if self.span_records:
             # in-flight = roots begun but not yet ended in the stream —

@@ -48,6 +48,11 @@ produces, from the JSONL alone:
   — sweep totals, bound violations and undeclared containers (both
   failures), worst bound ratio, and peak structure sizes, from
   ``kind="census"`` sweep records;
+- the **http-ingress section** (round 22; ``gateway/server.py``) — one
+  record per ``/v1/generate`` connection: status histogram (200 served
+  / 429 shed / 400 malformed), disconnect→cancel counts,
+  over-the-wire TTFT percentiles, bytes out and the worst inter-token
+  stream gap, from ``kind="http"`` records;
 - the **request-trace section** (round 14; ``telemetry/reqtrace.py``) —
   lifecycle trace counts, completeness (every span closed, parents
   acyclic), open spans, and phase totals from ``kind="span"`` records
@@ -734,6 +739,60 @@ def census_section(records: List[dict], out: dict) -> List[str]:
     return lines
 
 
+def ingress_section(records: List[dict], out: dict) -> List[str]:
+    """HTTP front door (round 22; ``kind="http"`` from
+    ``gateway/server.py``): one record per ``/v1/generate`` connection.
+    Status histogram (the SLOGate ladder over the wire: 200 served,
+    429 shed, 400 malformed), disconnect→cancel counts, TTFT measured
+    at the socket, bytes out, and the worst inter-token stream gap."""
+    recs = [r for r in records if r.get("kind") == "http"]
+    if not recs:
+        return []
+    lines = ["== http ingress =="]
+    statuses: dict = {}
+    for r in recs:
+        statuses[r.get("status", 0)] = statuses.get(r.get("status", 0),
+                                                    0) + 1
+    served = statuses.get(200, 0)
+    shed = statuses.get(429, 0)
+    disconnects = sum(1 for r in recs if r.get("disconnect"))
+    cancelled = sum(1 for r in recs
+                    if r.get("disconnect") and r.get("outcome") ==
+                    "cancelled")
+    lines.append(
+        f"  {len(recs)} connections: "
+        + ", ".join(f"{s}={n}" for s, n in sorted(statuses.items()))
+        + (f"; 429 rate {shed / len(recs):.1%}" if shed else "")
+    )
+    lines.append(
+        f"  disconnects {disconnects} ({cancelled} cancelled "
+        f"mid-stream); bytes out "
+        f"{sum(r.get('bytes', 0) or 0 for r in recs)}"
+    )
+    ttfts = [r["ttft_wire"] for r in recs
+             if r.get("ttft_wire") is not None]
+    if ttfts:
+        pct = percentiles(ttfts, qs=(50, 95))
+        p50, p95 = pct["p50"], pct["p95"]
+        lines.append(
+            f"  ttft over the wire p50 {p50 * 1e3:.1f} ms / "
+            f"p95 {p95 * 1e3:.1f} ms ({len(ttfts)} streams)"
+        )
+        out["http_ttft_wire_p50_ms"] = round(p50 * 1e3, 2)
+        out["http_ttft_wire_p95_ms"] = round(p95 * 1e3, 2)
+    gaps = [r["gap_max_ms"] for r in recs if r.get("gap_max_ms")]
+    if gaps:
+        lines.append(f"  worst stream gap {max(gaps):.1f} ms")
+        out["http_worst_gap_ms"] = round(max(gaps), 2)
+    out["http_connections"] = len(recs)
+    out["http_served"] = served
+    out["http_shed"] = shed
+    out["http_rejected"] = statuses.get(400, 0)
+    out["http_disconnects"] = disconnects
+    out["http_cancelled"] = cancelled
+    return lines
+
+
 def anomaly_section(records: List[dict], out: dict) -> List[str]:
     """Sentinel hits (``kind="anomaly"``): per-series counts and the
     latest excursions with their z-scores and baselines."""
@@ -770,12 +829,12 @@ def main(argv=None) -> int:
                    help="comma list of sections that MUST be present "
                         "(goodput, serving, warmup, fleet, pressure, "
                         "prefix, overlap, spans, cost, resource, "
-                        "census, anomaly) — exit non-zero otherwise; "
-                        "the ci_check.sh --telemetry-smoke, "
+                        "census, http, anomaly) — exit non-zero "
+                        "otherwise; the ci_check.sh --telemetry-smoke, "
                         "--warmup-smoke, --fleet-smoke, --obs-smoke, "
                         "--pressure-smoke, --trace-smoke, "
-                        "--overlap-smoke, --prefix-smoke and "
-                        "--soak-smoke gates")
+                        "--overlap-smoke, --prefix-smoke, --soak-smoke "
+                        "and --gateway-smoke gates")
     args = p.parse_args(argv)
 
     records = load_records(args.paths)
@@ -793,6 +852,7 @@ def main(argv=None) -> int:
     lines += cost_section(records, out)
     lines += resource_section(records, out)
     lines += census_section(records, out)
+    lines += ingress_section(records, out)
     lines += anomaly_section(records, out)
     if not lines:
         print(f"no telemetry records in {args.paths}", file=sys.stderr)
@@ -810,6 +870,7 @@ def main(argv=None) -> int:
         "cost": out.get("cost_programs", 0) > 0,
         "resource": out.get("resource_samples", 0) > 0,
         "census": out.get("census_sweeps", 0) > 0,
+        "http": out.get("http_connections", 0) > 0,
         "anomaly": out.get("anomalies", 0) > 0,
     }
     if not any(present.values()):

@@ -242,6 +242,13 @@ def test_census_meta_no_undeclared_containers(tiny_fleet):
         router, _, _ = _serve(cfg, params, retain_results=retain)
         owners = router.census_owners()
         assert owners, "router exposed no census owners"
+        # round 22: the HTTP front door sits on the same fleet and keeps
+        # its own long-lived tables (streams, ingress/cancel queues,
+        # wire-latency rings) — sweep its owners too. Unstarted: no
+        # threads, the container inventory is identical.
+        from pytorch_distributed_tpu.gateway import Gateway
+
+        owners = owners + Gateway(router).census_owners()
         for name, obj in owners:
             undecl = undeclared_containers(obj)
             assert undecl == [], (
@@ -460,9 +467,12 @@ def test_soak_miniature(tmp_path):
     """The --soak path itself: stream sessions through the 2-replica
     fleet with the observatory armed; census must close ok and the
     telemetry must round-trip the rotated mirror."""
-    row = _run_soak(tmp_path, 300, log_max_bytes=64 << 10)
-    assert row["serving_soak_sessions"] == 300
-    assert row["serving_soak_completed"] + row["serving_soak_shed"] == 300
+    # 150 sessions, not 300: this is the slowest fast-tier test and the
+    # tier sits a few seconds under its 870 s cap — the 20k @slow cell
+    # carries the volume; the 16 KiB cap keeps rotation exercised.
+    row = _run_soak(tmp_path, 150, log_max_bytes=16 << 10)
+    assert row["serving_soak_sessions"] == 150
+    assert row["serving_soak_completed"] + row["serving_soak_shed"] == 150
     assert row["serving_soak_census_verdict"] == "ok"
     assert row["serving_soak_census_undeclared"] == 0
     assert row["serving_soak_undeclared_at_start"] == 0
